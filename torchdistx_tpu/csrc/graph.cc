@@ -1,0 +1,281 @@
+// tdx-tpu native core: deferred-init op-graph recorder/replayer.
+//
+// TPU-native re-design of the reference's C++ graph machinery
+// (torchdistx src/cc/torchdistx/deferred_init.cc: Op/OpNode/TensorRecord,
+// chronological op numbering, dependency edges, materialization walk and
+// graph GC).  Because the compute path here is JAX/XLA, recorded values are
+// immutable; the reference's hardest machinery — in-place/view resolution via
+// storage aliasing and bidirectional graph walks — collapses into a pure DAG:
+// a node's replay schedule is exactly its transitive dependency closure in
+// chronological order (deps always carry lower op numbers than dependents).
+//
+// Split of responsibilities (mirrors the reference's L1/L2/L3 layering):
+//   C++  (this file): graph topology, chronological scheduling,
+//        materialization state, pin/refcount-based GC of replay caches,
+//        per-output shape/dtype metadata.
+//   Python (torchdistx_tpu/_graph.py): op closures and their execution on
+//        XLA devices (the analog of the reference's boxed redispatch).
+//
+// Exposed as a flat C ABI consumed via ctypes (pybind11 is unavailable in
+// this environment; the ABI is deliberately simple enough that ctypes adds
+// no overhead worth native bindings).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+enum class NodeState : int32_t {
+  kRecorded = 0,
+  kMaterialized = 1,
+  kReleased = 2,
+};
+
+struct OutputMeta {
+  std::vector<int64_t> dims;
+  int32_t dtype_code = -1;  // opaque to C++; Python maps to jnp dtypes
+};
+
+struct Node {
+  int64_t id = -1;  // chronological op number (reference: OpNode::op_nr_)
+  std::string name;
+  std::vector<int64_t> deps;        // producer node ids (unique)
+  std::vector<int64_t> dependents;  // consumer node ids
+  int32_t n_outputs = 0;
+  NodeState state = NodeState::kRecorded;
+  int64_t pins = 0;  // live user handles (FakeArrays) over this node's outputs
+  int64_t unmaterialized_dependents = 0;
+  std::vector<OutputMeta> outputs;
+};
+
+struct Graph {
+  std::mutex mu;
+  std::vector<Node> nodes;
+  int64_t materialized_count = 0;
+  int64_t released_count = 0;
+};
+
+bool valid_id(const Graph& g, int64_t id) {
+  return id >= 0 && static_cast<size_t>(id) < g.nodes.size();
+}
+
+// A node's replay cache can be dropped once it is materialized, no live
+// FakeArray handle can reach it, and every recorded consumer has already
+// materialized (so no future replay will need its output).  This is the
+// DAG analog of the reference's detachDependencies() graph GC
+// (deferred_init.cc:464-496,522-525).
+bool releasable(const Node& n) {
+  return n.state == NodeState::kMaterialized && n.pins == 0 &&
+         n.unmaterialized_dependents == 0;
+}
+
+}  // namespace
+
+#pragma GCC visibility push(default)
+extern "C" {
+
+void* tdx_graph_new() { return new Graph(); }
+
+void tdx_graph_free(void* h) { delete static_cast<Graph*>(h); }
+
+// Record one op.  deps may contain duplicates and -1 entries (non-graph
+// args); both are filtered here so Python can pass raw argument node ids.
+int64_t tdx_record_op(void* h, const char* name, const int64_t* deps,
+                      int64_t ndeps, int32_t n_outputs) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  int64_t id = static_cast<int64_t>(g.nodes.size());
+  Node n;
+  n.id = id;
+  n.name = name != nullptr ? name : "";
+  n.n_outputs = n_outputs;
+  n.outputs.resize(static_cast<size_t>(n_outputs));
+  std::unordered_set<int64_t> seen;
+  for (int64_t i = 0; i < ndeps; ++i) {
+    int64_t d = deps[i];
+    if (d < 0 || d >= id || !seen.insert(d).second) continue;
+    n.deps.push_back(d);
+  }
+  for (int64_t d : n.deps) {
+    Node& dep = g.nodes[static_cast<size_t>(d)];
+    dep.dependents.push_back(id);
+    if (dep.state == NodeState::kReleased) return -1;  // caller bug
+    dep.unmaterialized_dependents += 1;
+  }
+  g.nodes.push_back(std::move(n));
+  return id;
+}
+
+void tdx_set_output_meta(void* h, int64_t node, int32_t out_idx,
+                         const int64_t* dims, int32_t rank,
+                         int32_t dtype_code) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return;
+  Node& n = g.nodes[static_cast<size_t>(node)];
+  if (out_idx < 0 || out_idx >= n.n_outputs) return;
+  OutputMeta& m = n.outputs[static_cast<size_t>(out_idx)];
+  m.dims.assign(dims, dims + rank);
+  m.dtype_code = dtype_code;
+}
+
+// rank is returned; dims written into out_dims (caller provides capacity via
+// max_rank).  Returns -1 on bad ids.
+int32_t tdx_get_output_meta(void* h, int64_t node, int32_t out_idx,
+                            int64_t* out_dims, int32_t max_rank,
+                            int32_t* out_dtype_code) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return -1;
+  const Node& n = g.nodes[static_cast<size_t>(node)];
+  if (out_idx < 0 || out_idx >= n.n_outputs) return -1;
+  const OutputMeta& m = n.outputs[static_cast<size_t>(out_idx)];
+  int32_t rank = static_cast<int32_t>(m.dims.size());
+  if (rank > max_rank) return -1;
+  std::copy(m.dims.begin(), m.dims.end(), out_dims);
+  *out_dtype_code = m.dtype_code;
+  return rank;
+}
+
+// Build the replay schedule for `target`: every transitive dependency that is
+// not yet materialized, plus target itself, in chronological (== topological)
+// order.  Mirrors collectCallStack + sort-by-op_nr_
+// (reference deferred_init.cc:530-622) minus the in-place dependent walk,
+// which immutability makes unnecessary.  Returns count, or -1 if the caller
+// buffer is too small (call again with a bigger buffer), or -2 on bad input
+// (unknown node, or a required dependency was already released).
+int64_t tdx_collect_schedule(void* h, int64_t target, int64_t* out,
+                             int64_t cap) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, target)) return -2;
+  if (g.nodes[static_cast<size_t>(target)].state != NodeState::kRecorded) {
+    return 0;  // already materialized: nothing to replay
+  }
+  std::vector<int64_t> stack = {target};
+  std::unordered_set<int64_t> visited = {target};
+  std::vector<int64_t> sched;
+  while (!stack.empty()) {
+    int64_t id = stack.back();
+    stack.pop_back();
+    const Node& n = g.nodes[static_cast<size_t>(id)];
+    if (n.state == NodeState::kReleased) return -2;
+    if (n.state == NodeState::kMaterialized) continue;  // cached output
+    sched.push_back(id);
+    for (int64_t d : n.deps) {
+      if (visited.insert(d).second) stack.push_back(d);
+    }
+  }
+  std::sort(sched.begin(), sched.end());
+  if (static_cast<int64_t>(sched.size()) > cap) return -1;
+  std::copy(sched.begin(), sched.end(), out);
+  return static_cast<int64_t>(sched.size());
+}
+
+// Mark `node` materialized and report, via out_releasable, up to cap node ids
+// whose replay caches Python may now free (the node's deps — and the node
+// itself — that became releasable).  Returns count of releasable ids.
+int64_t tdx_mark_materialized(void* h, int64_t node, int64_t* out_releasable,
+                              int64_t cap) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return 0;
+  Node& n = g.nodes[static_cast<size_t>(node)];
+  if (n.state != NodeState::kRecorded) return 0;
+  n.state = NodeState::kMaterialized;
+  g.materialized_count += 1;
+  int64_t cnt = 0;
+  auto maybe_emit = [&](int64_t id) {
+    Node& m = g.nodes[static_cast<size_t>(id)];
+    if (releasable(m) && cnt < cap) {
+      m.state = NodeState::kReleased;
+      g.released_count += 1;
+      out_releasable[cnt++] = id;
+    }
+  };
+  for (int64_t d : n.deps) {
+    Node& dep = g.nodes[static_cast<size_t>(d)];
+    dep.unmaterialized_dependents -= 1;
+    maybe_emit(d);
+  }
+  maybe_emit(node);
+  return cnt;
+}
+
+int32_t tdx_node_state(void* h, int64_t node) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return -1;
+  return static_cast<int32_t>(g.nodes[static_cast<size_t>(node)].state);
+}
+
+// Pin/unpin: a live Python FakeArray handle pins its producer node so GC
+// never drops an output the user can still materialize.
+void tdx_pin(void* h, int64_t node) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (valid_id(g, node)) g.nodes[static_cast<size_t>(node)].pins += 1;
+}
+
+// Returns 1 if the unpin made the node releasable (Python should drop its
+// cached replay output), else 0.
+int32_t tdx_unpin(void* h, int64_t node) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return 0;
+  Node& n = g.nodes[static_cast<size_t>(node)];
+  n.pins -= 1;
+  if (releasable(n)) {
+    n.state = NodeState::kReleased;
+    g.released_count += 1;
+    return 1;
+  }
+  return 0;
+}
+
+int64_t tdx_num_nodes(void* h) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  return static_cast<int64_t>(g.nodes.size());
+}
+
+int64_t tdx_num_materialized(void* h) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  return g.materialized_count;
+}
+
+int64_t tdx_num_released(void* h) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  return g.released_count;
+}
+
+// Dependency introspection, used by Python for debugging / graph dumps.
+int64_t tdx_get_deps(void* h, int64_t node, int64_t* out, int64_t cap) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return -1;
+  const Node& n = g.nodes[static_cast<size_t>(node)];
+  if (static_cast<int64_t>(n.deps.size()) > cap) return -1;
+  std::copy(n.deps.begin(), n.deps.end(), out);
+  return static_cast<int64_t>(n.deps.size());
+}
+
+int64_t tdx_get_name(void* h, int64_t node, char* out, int64_t cap) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return -1;
+  const Node& n = g.nodes[static_cast<size_t>(node)];
+  int64_t len = static_cast<int64_t>(n.name.size());
+  if (len + 1 > cap) return -1;
+  std::memcpy(out, n.name.c_str(), static_cast<size_t>(len) + 1);
+  return len;
+}
+
+}  // extern "C"
+#pragma GCC visibility pop
