@@ -1,0 +1,41 @@
+"""Test harness: force an 8-device CPU platform so distributed behavior runs
+without TPU hardware — the analog of the reference emulating multi-node with
+single-host multi-GPU (reference tests/python/test_comm_hooks_fsdp.py via
+FSDPTest; SURVEY §4)."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(8), ("fsdp",))
+
+
+@pytest.fixture
+def mesh2x4():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("node", "local"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_rng():
+    import torchdistx_tpu as tdx
+
+    tdx.manual_seed(0)
+    yield

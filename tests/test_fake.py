@@ -1,0 +1,105 @@
+"""Fake-mode semantics.  Behavioral spec: reference
+tests/python/test_fake.py (enter/exit semantics, meta_like property
+preservation and error) plus fake-TPU-without-TPU, the analog of the
+reference's fake-CUDA-without-CUDA."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import ops
+
+
+def test_fake_creation_inside_mode():
+    with tdx.fake_mode():
+        x = ops.zeros((3, 4))
+    assert tdx.is_fake(x)
+    assert x.shape == (3, 4)
+    assert x.dtype == jnp.float32
+    assert "fake=True" in repr(x)
+
+
+def test_real_outside_mode():
+    x = ops.zeros((3, 4))
+    assert not tdx.is_fake(x)
+    assert isinstance(x, jax.Array)
+
+
+def test_mode_is_reentrant():
+    with tdx.fake_mode():
+        with tdx.fake_mode():
+            x = ops.ones((2,))
+        y = ops.ones((2,))
+    assert tdx.is_fake(x) and tdx.is_fake(y)
+    z = ops.ones((2,))
+    assert not tdx.is_fake(z)
+
+
+def test_fake_tpu_claim_without_tpu():
+    # On the CPU-only test platform, claim a TPU device anyway — the analog
+    # of fake_cuda on a CUDA-less host (reference test_fake.py:13-40).
+    with tdx.fake_mode(fake_tpu=True):
+        x = ops.zeros((5, 5))
+    assert tdx.is_fake(x)
+    assert str(x.device) == "tpu:0"
+
+
+def test_ops_on_fakes_propagate_shapes():
+    with tdx.fake_mode():
+        a = ops.ones((4, 8))
+        b = ops.ones((8, 16))
+        c = a @ b
+        d = (c + 1.0).astype(jnp.bfloat16)
+        s = d.sum(axis=0)
+    assert c.shape == (4, 16)
+    assert d.dtype == jnp.bfloat16
+    assert s.shape == (16,)
+    assert all(tdx.is_fake(t) for t in (c, d, s))
+
+
+def test_fake_from_plain_mode_cannot_materialize():
+    with tdx.fake_mode():
+        x = ops.zeros((2, 2))
+    assert not tdx.can_materialize(x)
+    with pytest.raises(RuntimeError, match="cannot be materialized"):
+        tdx.materialize_tensor(x)
+
+
+def test_no_truth_value():
+    with tdx.fake_mode():
+        x = ops.zeros((2,))
+    with pytest.raises(RuntimeError, match="no storage"):
+        bool(x)
+
+
+def test_meta_like_preserves_properties():
+    # reference test_fake.py:43-60
+    with tdx.fake_mode():
+        x = ops.ones((7, 3), dtype=jnp.bfloat16)
+    m = tdx.meta_like(x)
+    assert isinstance(m, jax.ShapeDtypeStruct)
+    assert m.shape == (7, 3)
+    assert m.dtype == jnp.bfloat16
+
+    r = jnp.ones((2, 2))
+    m2 = tdx.meta_like(r)
+    assert m2.shape == (2, 2)
+
+
+def test_meta_like_rejects_non_array():
+    with pytest.raises(ValueError):
+        tdx.meta_like(object())
+
+
+def test_generic_jnp_surface_via_ops():
+    with tdx.fake_mode():
+        a = ops.ones((2, 3))
+        b = ops.concatenate([a, a], axis=0)
+        c = ops.exp(b)
+    assert b.shape == (4, 3)
+    assert c.shape == (4, 3)
+    # and on real arrays the same surface executes for real
+    r = ops.concatenate([jnp.ones((1, 2)), jnp.zeros((1, 2))], axis=0)
+    assert isinstance(r, jax.Array)
+    assert r.shape == (2, 2)

@@ -78,6 +78,8 @@ _lib.tdx_num_released.restype = _i64
 _lib.tdx_num_released.argtypes = [ctypes.c_void_p]
 _lib.tdx_get_deps.restype = _i64
 _lib.tdx_get_deps.argtypes = [ctypes.c_void_p, _i64, _i64p, _i64]
+_lib.tdx_get_dependents.restype = _i64
+_lib.tdx_get_dependents.argtypes = [ctypes.c_void_p, _i64, _i64p, _i64]
 _lib.tdx_get_name.restype = _i64
 _lib.tdx_get_name.argtypes = [ctypes.c_void_p, _i64, ctypes.c_char_p, _i64]
 
@@ -146,9 +148,13 @@ class NativeGraph:
 
     def mark_materialized(self, node: int) -> list[int]:
         cap = 64
-        buf = (ctypes.c_int64 * cap)()
-        n = _lib.tdx_mark_materialized(self._h, node, buf, cap)
-        return list(buf[:n])
+        while True:
+            buf = (ctypes.c_int64 * cap)()
+            n = _lib.tdx_mark_materialized(self._h, node, buf, cap)
+            if n < 0:  # -(needed count): retry with a big-enough buffer
+                cap = -n
+                continue
+            return list(buf[:n])
 
     def node_state(self, node: int) -> int:
         return _lib.tdx_node_state(self._h, node)
@@ -168,15 +174,23 @@ class NativeGraph:
     def num_released(self) -> int:
         return _lib.tdx_num_released(self._h)
 
-    def deps(self, node: int) -> list[int]:
+    def _read_ids(self, c_fn, node: int) -> list[int]:
         cap = 256
         while True:
             buf = (ctypes.c_int64 * cap)()
-            n = _lib.tdx_get_deps(self._h, node, buf, cap)
+            n = c_fn(self._h, node, buf, cap)
+            if n == -2:
+                raise KeyError(f"unknown node {node}")
             if n == -1:
                 cap *= 8
                 continue
             return list(buf[:n])
+
+    def deps(self, node: int) -> list[int]:
+        return self._read_ids(_lib.tdx_get_deps, node)
+
+    def dependents(self, node: int) -> list[int]:
+        return self._read_ids(_lib.tdx_get_dependents, node)
 
     def name(self, node: int) -> str:
         cap = 512

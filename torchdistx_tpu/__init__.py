@@ -1,0 +1,44 @@
+"""torchdistx_tpu — a TPU-native framework with the capabilities of
+pytorch/torchdistx, built from scratch on JAX/XLA/PJRT.
+
+Flagship features (reference README.md:15-18):
+  - fake tensors (:mod:`torchdistx_tpu.fake`)
+  - deferred module initialization (:mod:`torchdistx_tpu.deferred_init`),
+    with TPU-native sharded materialization
+  - distributed training algorithms: FSDP-style sharded step with a
+    gradient comm-hook interface, GossipGraD, SlowMo
+    (:mod:`torchdistx_tpu.parallel`)
+  - AnyPrecisionAdamW (:mod:`torchdistx_tpu.optimizers`)
+"""
+
+__version__ = "0.1.0.dev0"
+
+from . import nn, ops
+from .deferred_init import (
+    can_materialize,
+    deferred_init,
+    is_deferred,
+    materialize_module,
+    materialize_tensor,
+)
+from .fake import FakeArray, FakeDevice, fake_mode, is_fake, meta_like
+from .utils.rng import manual_seed, next_rng_key, rng_scope
+
+__all__ = [
+    "__version__",
+    "nn",
+    "ops",
+    "fake_mode",
+    "is_fake",
+    "meta_like",
+    "FakeArray",
+    "FakeDevice",
+    "deferred_init",
+    "is_deferred",
+    "can_materialize",
+    "materialize_tensor",
+    "materialize_module",
+    "manual_seed",
+    "next_rng_key",
+    "rng_scope",
+]

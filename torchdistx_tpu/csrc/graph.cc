@@ -100,10 +100,16 @@ int64_t tdx_record_op(void* h, const char* name, const int64_t* deps,
     if (d < 0 || d >= id || !seen.insert(d).second) continue;
     n.deps.push_back(d);
   }
+  // validate before mutating anything so a rejected record leaves the
+  // graph untouched
+  for (int64_t d : n.deps) {
+    if (g.nodes[static_cast<size_t>(d)].state == NodeState::kReleased) {
+      return -1;  // caller bug: recording on a garbage-collected node
+    }
+  }
   for (int64_t d : n.deps) {
     Node& dep = g.nodes[static_cast<size_t>(d)];
     dep.dependents.push_back(id);
-    if (dep.state == NodeState::kReleased) return -1;  // caller bug
     dep.unmaterialized_dependents += 1;
   }
   g.nodes.push_back(std::move(n));
@@ -176,9 +182,11 @@ int64_t tdx_collect_schedule(void* h, int64_t target, int64_t* out,
   return static_cast<int64_t>(sched.size());
 }
 
-// Mark `node` materialized and report, via out_releasable, up to cap node ids
+// Mark `node` materialized and report, via out_releasable, the node ids
 // whose replay caches Python may now free (the node's deps — and the node
-// itself — that became releasable).  Returns count of releasable ids.
+// itself — that became releasable).  Returns the count of releasable ids;
+// if the caller buffer is too small, returns -(needed count) WITHOUT
+// mutating anything so the caller can retry with a bigger buffer.
 int64_t tdx_mark_materialized(void* h, int64_t node, int64_t* out_releasable,
                               int64_t cap) {
   Graph& g = *static_cast<Graph*>(h);
@@ -186,12 +194,26 @@ int64_t tdx_mark_materialized(void* h, int64_t node, int64_t* out_releasable,
   if (!valid_id(g, node)) return 0;
   Node& n = g.nodes[static_cast<size_t>(node)];
   if (n.state != NodeState::kRecorded) return 0;
+
+  // phase 1: count what would become releasable
+  int64_t needed = 0;
+  for (int64_t d : n.deps) {
+    const Node& dep = g.nodes[static_cast<size_t>(d)];
+    if (dep.state == NodeState::kMaterialized && dep.pins == 0 &&
+        dep.unmaterialized_dependents == 1) {
+      needed += 1;
+    }
+  }
+  if (n.pins == 0 && n.unmaterialized_dependents == 0) needed += 1;
+  if (needed > cap) return -needed;
+
+  // phase 2: commit
   n.state = NodeState::kMaterialized;
   g.materialized_count += 1;
   int64_t cnt = 0;
   auto maybe_emit = [&](int64_t id) {
     Node& m = g.nodes[static_cast<size_t>(id)];
-    if (releasable(m) && cnt < cap) {
+    if (releasable(m)) {
       m.state = NodeState::kReleased;
       g.released_count += 1;
       out_releasable[cnt++] = id;
@@ -259,11 +281,21 @@ int64_t tdx_num_released(void* h) {
 int64_t tdx_get_deps(void* h, int64_t node, int64_t* out, int64_t cap) {
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
-  if (!valid_id(g, node)) return -1;
+  if (!valid_id(g, node)) return -2;
   const Node& n = g.nodes[static_cast<size_t>(node)];
   if (static_cast<int64_t>(n.deps.size()) > cap) return -1;
   std::copy(n.deps.begin(), n.deps.end(), out);
   return static_cast<int64_t>(n.deps.size());
+}
+
+int64_t tdx_get_dependents(void* h, int64_t node, int64_t* out, int64_t cap) {
+  Graph& g = *static_cast<Graph*>(h);
+  std::lock_guard<std::mutex> lock(g.mu);
+  if (!valid_id(g, node)) return -2;
+  const Node& n = g.nodes[static_cast<size_t>(node)];
+  if (static_cast<int64_t>(n.dependents.size()) > cap) return -1;
+  std::copy(n.dependents.begin(), n.dependents.end(), out);
+  return static_cast<int64_t>(n.dependents.size());
 }
 
 int64_t tdx_get_name(void* h, int64_t node, char* out, int64_t cap) {

@@ -1,0 +1,323 @@
+"""Fake arrays and ``fake_mode`` — metadata-only arrays with claimed devices.
+
+TPU-native counterpart of the reference's fake-tensor feature
+(torchdistx src/python/torchdistx/fake.py and src/cc/torchdistx/fake.cc):
+a :class:`FakeArray` carries shape/dtype and a *claimed* device but owns no
+buffer anywhere — not on device, not on host.  Shape/dtype propagation runs
+through ``jax.eval_shape`` (XLA's shape inference), the analog of the
+reference's redispatch-to-Meta-backend (fake.cc:476-489).
+
+The reference's ``fake_cuda=True`` — faking CUDA tensors on a machine with
+no GPU via a no-op device guard (fake.cc:556-586) — maps to
+``fake_mode(fake_tpu=True)``: TPU devices can be claimed on a CPU-only host
+via a :class:`FakeDevice` descriptor instead of a PJRT device handle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FakeArray",
+    "FakeDevice",
+    "fake_mode",
+    "is_fake",
+    "meta_like",
+    "current_session",
+    "in_fake_mode",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeDevice:
+    """A claimed device that need not exist on this host.
+
+    The analog of the reference's fake CUDA device: a fake tensor remembers
+    ``device="cuda:0"`` even on a CUDA-less machine (fake.cc:69-73).  Here a
+    FakeArray can claim ``FakeDevice("tpu", 0)`` on a CPU-only host; at
+    materialization time the claim resolves to a real PJRT device if one
+    exists.
+    """
+
+    platform: str
+    index: int = 0
+
+    def __repr__(self) -> str:
+        return f"{self.platform}:{self.index}"
+
+    def resolve(self) -> Optional[jax.Device]:
+        try:
+            devs = jax.devices(self.platform)
+        except RuntimeError:
+            return None
+        if self.index < len(devs):
+            return devs[self.index]
+        return None
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.fake_level = 0
+        self.fake_tpu = False
+        self.session: Any = None  # RecordingSession during deferred_init
+        self.default_device: Optional[FakeDevice] = None
+
+
+_tls = _TLS()
+
+
+def in_fake_mode() -> bool:
+    return _tls.fake_level > 0
+
+
+def current_session() -> Any:
+    return _tls.session
+
+
+@contextlib.contextmanager
+def fake_mode(*, fake_tpu: bool = False):
+    """Context manager under which array-producing ops return fake arrays.
+
+    Re-entrant, like the reference's TLS mode counter (fake.cc:595-623).
+    With ``fake_tpu=True``, creation ops default to claiming a TPU device
+    even when no TPU is attached.
+    """
+    _tls.fake_level += 1
+    prev_fake_tpu = _tls.fake_tpu
+    prev_default = _tls.default_device
+    if fake_tpu:
+        _tls.fake_tpu = True
+        _tls.default_device = FakeDevice("tpu", 0)
+    try:
+        yield
+    finally:
+        _tls.fake_level -= 1
+        _tls.fake_tpu = prev_fake_tpu
+        _tls.default_device = prev_default
+
+
+def _enter_deferred(session: Any) -> None:
+    if _tls.session is not None:
+        raise RuntimeError("deferred_init contexts cannot be nested")
+    _tls.session = session
+    _tls.fake_level += 1
+
+
+def _leave_deferred() -> None:
+    _tls.session = None
+    _tls.fake_level -= 1
+
+
+class FakeArray:
+    """An array with shape/dtype/claimed-device but no storage.
+
+    When produced inside ``deferred_init``, it additionally carries a record
+    (session + graph node) from which it can be materialized; a FakeArray
+    produced under plain ``fake_mode()`` has no record and can never be
+    materialized — matching the reference, where only tensors created in a
+    deferred-init context can materialize
+    (reference deferred_init.cc:800-811).
+    """
+
+    __slots__ = ("_aval", "_device", "_session", "_node", "_out_idx", "__weakref__")
+
+    def __init__(
+        self,
+        aval: jax.ShapeDtypeStruct,
+        device: Any = None,
+        session: Any = None,
+        node: int = -1,
+        out_idx: int = 0,
+    ) -> None:
+        self._aval = aval
+        self._device = device if device is not None else _default_claim()
+        self._session = session
+        self._node = node
+        self._out_idx = out_idx
+        if session is not None and node >= 0:
+            session.pin(node)
+
+    def __del__(self) -> None:
+        try:
+            if self._session is not None and self._node >= 0:
+                self._session.unpin(self._node)
+        except Exception:
+            pass  # interpreter teardown
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self._aval.shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self._aval.shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * jnp.dtype(self.dtype).itemsize
+
+    @property
+    def aval(self) -> jax.ShapeDtypeStruct:
+        return self._aval
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def is_deferred(self) -> bool:
+        return self._session is not None and self._node >= 0
+
+    def __len__(self) -> int:
+        if not self._aval.shape:
+            raise TypeError("len() of a 0-d fake array")
+        return self._aval.shape[0]
+
+    def __repr__(self) -> str:
+        # parity with the reference's repr patch printing fake=True
+        # (reference fake.py:15-40)
+        return (
+            f"FakeArray(shape={tuple(self._aval.shape)}, "
+            f"dtype={jnp.dtype(self.dtype).name}, device={self._device}, "
+            f"fake=True)"
+        )
+
+    def __bool__(self) -> bool:
+        raise RuntimeError(
+            "the truth value of a fake array is data-dependent; fake arrays "
+            "have no storage (materialize first)"
+        )
+
+    def __format__(self, spec: str) -> str:
+        return repr(self)
+
+    # -- ops (recorded / shape-propagated) --------------------------------
+
+    def _op(self, fn, *args, **kwargs):
+        from .ops import apply_op
+
+        return apply_op(fn, *args, **kwargs)
+
+    def __add__(self, o):
+        return self._op(jnp.add, self, o)
+
+    def __radd__(self, o):
+        return self._op(jnp.add, o, self)
+
+    def __sub__(self, o):
+        return self._op(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return self._op(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return self._op(jnp.multiply, self, o)
+
+    def __rmul__(self, o):
+        return self._op(jnp.multiply, o, self)
+
+    def __truediv__(self, o):
+        return self._op(jnp.divide, self, o)
+
+    def __rtruediv__(self, o):
+        return self._op(jnp.divide, o, self)
+
+    def __pow__(self, o):
+        return self._op(jnp.power, self, o)
+
+    def __neg__(self):
+        return self._op(jnp.negative, self)
+
+    def __matmul__(self, o):
+        return self._op(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return self._op(jnp.matmul, o, self)
+
+    def __getitem__(self, idx):
+        return self._op(lambda x: x[idx], self)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._op(lambda x: jnp.reshape(x, shape), self)
+
+    def astype(self, dtype):
+        return self._op(lambda x: x.astype(dtype), self)
+
+    def transpose(self, *axes):
+        ax = axes if axes else None
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            ax = tuple(axes[0])
+        return self._op(lambda x: jnp.transpose(x, ax), self)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def mean(self, *a, **k):
+        return self._op(lambda x: jnp.mean(x, *a, **k), self)
+
+    def sum(self, *a, **k):
+        return self._op(lambda x: jnp.sum(x, *a, **k), self)
+
+    def min(self, *a, **k):
+        return self._op(lambda x: jnp.min(x, *a, **k), self)
+
+    def max(self, *a, **k):
+        return self._op(lambda x: jnp.max(x, *a, **k), self)
+
+    def flatten(self):
+        return self.reshape((self.size,))
+
+
+def _default_claim() -> Any:
+    if _tls.default_device is not None:
+        return _tls.default_device
+    try:
+        return jax.devices()[0]
+    except RuntimeError:
+        return FakeDevice("cpu", 0)
+
+
+def is_fake(x: Any) -> bool:
+    """True if ``x`` is a fake (storage-less) array.
+
+    Parity: reference fake.py:59-66.
+    """
+    return isinstance(x, FakeArray)
+
+
+def meta_like(x: Any) -> jax.ShapeDtypeStruct:
+    """Return the abstract (shape, dtype) descriptor of ``x``.
+
+    The reference returns a meta-device tensor sharing the fake tensor's
+    metadata (fake.py:69-82); the JAX-native analog of a meta tensor is a
+    ``jax.ShapeDtypeStruct``.  Accepts fake and real arrays.
+    """
+    if isinstance(x, FakeArray):
+        return x.aval
+    if isinstance(x, (jax.Array,)) or hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), jnp.dtype(x.dtype))
+    raise ValueError(
+        f"meta_like expects an array-like with shape/dtype, got {type(x)!r}"
+    )

@@ -1,0 +1,37 @@
+from . import functional, init
+from .layers import (
+    GELU,
+    BatchNorm2d,
+    Conv2d,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    Linear,
+    ModuleList,
+    ReLU,
+    RMSNorm,
+    Sequential,
+    SiLU,
+)
+from .module import Buffer, Module, Parameter, functional_call
+
+__all__ = [
+    "functional",
+    "init",
+    "Module",
+    "Parameter",
+    "Buffer",
+    "functional_call",
+    "Linear",
+    "Embedding",
+    "LayerNorm",
+    "RMSNorm",
+    "Dropout",
+    "Sequential",
+    "ModuleList",
+    "Conv2d",
+    "BatchNorm2d",
+    "ReLU",
+    "GELU",
+    "SiLU",
+]

@@ -1,0 +1,106 @@
+"""Parameter initializers, routed through the op-interposition layer so they
+record under ``deferred_init`` and execute on-device otherwise.
+
+Math follows the standard Kaiming/Xavier definitions (what the reference's
+modules get from ``torch.nn.init`` — e.g. Linear's kaiming_uniform reset in
+the deferred-init call stack, SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..utils.rng import next_rng_key
+
+__all__ = [
+    "zeros",
+    "ones",
+    "constant",
+    "normal",
+    "uniform",
+    "xavier_uniform",
+    "xavier_normal",
+    "kaiming_uniform",
+    "kaiming_normal",
+    "truncated_normal",
+]
+
+
+def zeros(shape, dtype=jnp.float32):
+    return ops.zeros(shape, dtype)
+
+
+def ones(shape, dtype=jnp.float32):
+    return ops.ones(shape, dtype)
+
+
+def constant(shape, value, dtype=jnp.float32):
+    return ops.full(shape, value, dtype)
+
+
+def normal(shape, std=1.0, mean=0.0, dtype=jnp.float32, key=None):
+    key = key if key is not None else next_rng_key()
+    x = ops.random_normal(key, shape, dtype)
+    if std != 1.0:
+        x = x * jnp.asarray(std, dtype)
+    if mean != 0.0:
+        x = x + jnp.asarray(mean, dtype)
+    return x
+
+
+def uniform(shape, low=0.0, high=1.0, dtype=jnp.float32, key=None):
+    key = key if key is not None else next_rng_key()
+    return ops.random_uniform(key, shape, dtype, minval=low, maxval=high)
+
+
+def _fan(shape) -> tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    # convention: (out, in, *receptive) like torch's (out_ch, in_ch, kh, kw)
+    receptive = math.prod(shape[2:]) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def xavier_uniform(shape, gain=1.0, dtype=jnp.float32, key=None):
+    fan_in, fan_out = _fan(shape)
+    bound = gain * math.sqrt(6.0 / (fan_in + fan_out))
+    return uniform(shape, -bound, bound, dtype, key)
+
+
+def xavier_normal(shape, gain=1.0, dtype=jnp.float32, key=None):
+    fan_in, fan_out = _fan(shape)
+    std = gain * math.sqrt(2.0 / (fan_in + fan_out))
+    return normal(shape, std=std, dtype=dtype, key=key)
+
+
+def kaiming_uniform(shape, a=math.sqrt(5), dtype=jnp.float32, key=None):
+    fan_in, _ = _fan(shape)
+    gain = math.sqrt(2.0 / (1 + a * a))
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return uniform(shape, -bound, bound, dtype, key)
+
+
+def kaiming_normal(shape, a=0.0, dtype=jnp.float32, key=None):
+    fan_in, _ = _fan(shape)
+    gain = math.sqrt(2.0 / (1 + a * a))
+    std = gain / math.sqrt(fan_in)
+    return normal(shape, std=std, dtype=dtype, key=key)
+
+
+def truncated_normal(shape, std=1.0, dtype=jnp.float32, key=None):
+    key = key if key is not None else next_rng_key()
+    x = ops.random_truncated_normal(key, -2.0, 2.0, shape, dtype)
+    return x * jnp.asarray(std, dtype) if std != 1.0 else x
+
+
+def linear_bias_bound(fan_in: int) -> float:
+    return 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0

@@ -1,0 +1,229 @@
+"""Core layers.  Construction goes through ``nn.init`` (and therefore the
+fake/deferred interposition layer); forwards are plain jnp/lax on real
+arrays, jit-compilable via ``functional_call``."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import init
+from .module import Buffer, Module, Parameter
+
+__all__ = [
+    "Linear",
+    "Embedding",
+    "LayerNorm",
+    "RMSNorm",
+    "Dropout",
+    "Sequential",
+    "ModuleList",
+    "Conv2d",
+    "BatchNorm2d",
+    "ReLU",
+    "GELU",
+    "SiLU",
+]
+
+
+class Linear(Module):
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        bias: bool = True,
+        dtype=jnp.float32,
+    ) -> None:
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = Parameter(
+            init.kaiming_uniform((out_features, in_features), dtype=dtype)
+        )
+        if bias:
+            bound = init.linear_bias_bound(in_features)
+            self.bias = Parameter(
+                init.uniform((out_features,), -bound, bound, dtype=dtype)
+            )
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, features: int, dtype=jnp.float32):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.weight = Parameter(
+            init.normal((num_embeddings, features), std=1.0, dtype=dtype)
+        )
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight)
+
+
+class LayerNorm(Module):
+    def __init__(self, features: int, eps: float = 1e-5, dtype=jnp.float32):
+        super().__init__()
+        self.eps = eps
+        self.weight = Parameter(init.ones((features,), dtype=dtype))
+        self.bias = Parameter(init.zeros((features,), dtype=dtype))
+
+    def forward(self, x):
+        return F.layer_norm(x, self.weight, self.bias, self.eps)
+
+
+class RMSNorm(Module):
+    def __init__(self, features: int, eps: float = 1e-6, dtype=jnp.float32):
+        super().__init__()
+        self.eps = eps
+        self.weight = Parameter(init.ones((features,), dtype=dtype))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.eps)
+
+
+class Dropout(Module):
+    def __init__(self, rate: float = 0.5):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, x, key: Optional[jax.Array] = None):
+        return F.dropout(x, self.rate, key, training=self.training)
+
+
+class Sequential(Module):
+    def __init__(self, *layers: Module):
+        super().__init__()
+        for i, layer in enumerate(layers):
+            self._modules[str(i)] = layer
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx: int) -> Module:
+        return self._modules[str(idx)]
+
+    def forward(self, x):
+        for layer in self._modules.values():
+            x = layer(x)
+        return x
+
+
+class ModuleList(Module):
+    def __init__(self, modules: Sequence[Module] = ()):
+        super().__init__()
+        for i, m in enumerate(modules):
+            self._modules[str(i)] = m
+
+    def append(self, m: Module) -> "ModuleList":
+        self._modules[str(len(self._modules))] = m
+        return self
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx: int) -> Module:
+        return self._modules[str(idx)]
+
+    def forward(self, *a, **k):
+        raise NotImplementedError("ModuleList is a container")
+
+
+class Conv2d(Module):
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups: int = 1,
+        bias: bool = True,
+        dtype=jnp.float32,
+    ):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        shape = (out_channels, in_channels // groups, *kernel_size)
+        self.weight = Parameter(init.kaiming_uniform(shape, dtype=dtype))
+        if bias:
+            fan_in = (in_channels // groups) * math.prod(kernel_size)
+            bound = init.linear_bias_bound(fan_in)
+            self.bias = Parameter(
+                init.uniform((out_channels,), -bound, bound, dtype=dtype)
+            )
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv2d(
+            x,
+            self.weight,
+            self.bias,
+            stride=self.stride,
+            padding=self.padding,
+            dilation=self.dilation,
+            groups=self.groups,
+        )
+
+
+class BatchNorm2d(Module):
+    """Inference-style batchnorm over NCHW plus running-stat buffers.
+
+    Training-mode batch statistics are computed on the fly; running stats
+    update is left to the trainer (functional purity under jit).
+    """
+
+    def __init__(self, features: int, eps: float = 1e-5, momentum: float = 0.1,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.eps = eps
+        self.momentum = momentum
+        self.weight = Parameter(init.ones((features,), dtype=dtype))
+        self.bias = Parameter(init.zeros((features,), dtype=dtype))
+        self.running_mean = Buffer(init.zeros((features,), dtype=dtype))
+        self.running_var = Buffer(init.ones((features,), dtype=dtype))
+
+    def forward(self, x):
+        if self.training:
+            mean = jnp.mean(x, axis=(0, 2, 3))
+            var = jnp.var(x, axis=(0, 2, 3))
+        else:
+            mean, var = self.running_mean, self.running_var
+        inv = jax.lax.rsqrt(var + self.eps) * self.weight
+        return (x - mean.reshape(1, -1, 1, 1)) * inv.reshape(1, -1, 1, 1) + \
+            self.bias.reshape(1, -1, 1, 1)
+
+
+class ReLU(Module):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class GELU(Module):
+    def forward(self, x):
+        return F.gelu(x)
+
+
+class SiLU(Module):
+    def forward(self, x):
+        return F.silu(x)

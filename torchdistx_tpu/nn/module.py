@@ -1,0 +1,221 @@
+"""Eager module system over JAX arrays.
+
+The reference composes with ``torch.nn.Module``; this framework brings its
+own module tree with the same object model — stateful modules holding
+``_parameters`` / ``_buffers`` / ``_modules`` dicts that
+``materialize_module`` rewrites in place (parity with reference
+src/python/torchdistx/deferred_init.py:87-124, which mutates those same
+dicts) — while keeping the *compute* functional: ``functional_call`` binds a
+parameter pytree for the duration of one forward so the whole step can be
+``jax.jit`` / ``pjit`` compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+from ..fake import FakeArray
+
+__all__ = ["Module", "Parameter", "Buffer", "functional_call"]
+
+
+class Parameter:
+    """Marker wrapper used at assignment time: ``self.w = Parameter(arr)``
+    registers ``arr`` as a trainable parameter.  The raw array is what gets
+    stored and returned on attribute access."""
+
+    def __init__(self, data: Any) -> None:
+        self.data = data
+
+
+class Buffer:
+    """Like :class:`Parameter` but registers non-trainable state."""
+
+    def __init__(self, data: Any) -> None:
+        self.data = data
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, FakeArray))
+
+
+class Module:
+    def __init__(self) -> None:
+        object.__setattr__(self, "_parameters", {})
+        object.__setattr__(self, "_buffers", {})
+        object.__setattr__(self, "_modules", {})
+        object.__setattr__(self, "training", True)
+
+    # -- attribute plumbing ------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Parameter):
+            self._parameters[name] = value.data
+            self._buffers.pop(name, None)
+            self._modules.pop(name, None)
+        elif isinstance(value, Buffer):
+            self._buffers[name] = value.data
+            self._parameters.pop(name, None)
+            self._modules.pop(name, None)
+        elif isinstance(value, Module):
+            self._modules[name] = value
+            self._parameters.pop(name, None)
+            self._buffers.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_modules"):
+            d = object.__getattribute__(self, store)
+            if name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def register_parameter(self, name: str, value: Any) -> None:
+        self._parameters[name] = value
+
+    def register_buffer(self, name: str, value: Any) -> None:
+        self._buffers[name] = value
+
+    # -- traversal ---------------------------------------------------------
+
+    def children(self) -> Iterator["Module"]:
+        yield from self._modules.values()
+
+    def named_children(self) -> Iterator[tuple[str, "Module"]]:
+        yield from self._modules.items()
+
+    def modules(self) -> Iterator["Module"]:
+        yield self
+        for child in self._modules.values():
+            yield from child.modules()
+
+    def named_modules(self, prefix: str = "") -> Iterator[tuple[str, "Module"]]:
+        yield prefix, self
+        for name, child in self._modules.items():
+            sub = f"{prefix}.{name}" if prefix else name
+            yield from child.named_modules(sub)
+
+    def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for cname, child in self._modules.items():
+            sub = f"{prefix}.{cname}" if prefix else cname
+            yield from child.named_parameters(sub)
+
+    def parameters(self) -> Iterator[Any]:
+        for _, p in self.named_parameters():
+            yield p
+
+    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for cname, child in self._modules.items():
+            sub = f"{prefix}.{cname}" if prefix else cname
+            yield from child.named_buffers(sub)
+
+    def buffers(self) -> Iterator[Any]:
+        for _, b in self.named_buffers():
+            yield b
+
+    # -- state -------------------------------------------------------------
+
+    def state_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        out.update(self.named_parameters())
+        out.update(self.named_buffers())
+        return out
+
+    def load_state_dict(self, state: dict[str, Any], strict: bool = True) -> None:
+        own = dict(self.state_dict())
+        missing = set(own) - set(state)
+        unexpected = set(state) - set(own)
+        if strict and (missing or unexpected):
+            raise KeyError(
+                f"load_state_dict mismatch: missing={sorted(missing)} "
+                f"unexpected={sorted(unexpected)}"
+            )
+        for key, value in state.items():
+            if key not in own:
+                continue
+            self._set_by_path(key, value)
+
+    def _set_by_path(self, path: str, value: Any) -> None:
+        parts = path.split(".")
+        mod: Module = self
+        for p in parts[:-1]:
+            mod = mod._modules[p]
+        leaf = parts[-1]
+        if leaf in mod._parameters:
+            mod._parameters[leaf] = value
+        elif leaf in mod._buffers:
+            mod._buffers[leaf] = value
+        else:
+            raise KeyError(f"no parameter or buffer at {path!r}")
+
+    def train(self, mode: bool = True) -> "Module":
+        object.__setattr__(self, "training", mode)
+        for child in self.children():
+            child.train(mode)
+        return self
+
+    def eval(self) -> "Module":
+        return self.train(False)
+
+    # -- execution ---------------------------------------------------------
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        lines = [f"{type(self).__name__}("]
+        for name, child in self._modules.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else f"{type(self).__name__}()"
+
+
+def functional_call(
+    module: Module,
+    params_and_buffers: dict[str, Any],
+    args: tuple = (),
+    kwargs: Optional[dict[str, Any]] = None,
+) -> Any:
+    """Run ``module`` with ``params_and_buffers`` temporarily bound.
+
+    The JAX-native analog of ``torch.func.functional_call``: inside
+    ``jax.jit``, the bound values are tracers, making the whole forward a
+    pure function of the parameter pytree.
+    """
+    kwargs = kwargs or {}
+    saved: dict[str, Any] = {}
+    for key, value in params_and_buffers.items():
+        saved[key] = _get_by_path(module, key)
+        module._set_by_path(key, value)
+    try:
+        return module(*args, **kwargs)
+    finally:
+        for key, value in saved.items():
+            module._set_by_path(key, value)
+
+
+def _get_by_path(module: Module, path: str) -> Any:
+    parts = path.split(".")
+    mod: Module = module
+    for p in parts[:-1]:
+        mod = mod._modules[p]
+    leaf = parts[-1]
+    if leaf in mod._parameters:
+        return mod._parameters[leaf]
+    if leaf in mod._buffers:
+        return mod._buffers[leaf]
+    raise KeyError(f"no parameter or buffer at {path!r}")

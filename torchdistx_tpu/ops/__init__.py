@@ -1,0 +1,300 @@
+"""Op interposition layer: every array-producing op funnels through
+:func:`apply_op`, the single choke point at which fake-mode and deferred-init
+recording interpose.
+
+This is the TPU-native answer to the reference's boxed dispatcher fallback
+(torchdistx src/cc/torchdistx/fake.cc:546-548 registers a catch-all for every
+aten op; deferred_init.cc:879-883 likewise).  JAX has no global dispatcher to
+hook, so the framework routes its own ops — the ``ops`` namespace mirrors
+``jax.numpy`` via ``__getattr__`` — through one function that:
+
+1. propagates shapes/dtypes with ``jax.eval_shape`` (the analog of
+   redispatching to the Meta backend, fake.cc:476-489);
+2. under ``deferred_init``, records the op into the native graph
+   (the analog of ``recordOp``, deferred_init.cc:674-697);
+3. under plain ``fake_mode``, returns unmaterializable fake arrays;
+4. otherwise executes the op for real on XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .._graph import NodeRef
+from ..fake import (
+    FakeArray,
+    FakeDevice,
+    current_session,
+    in_fake_mode,
+)
+
+__all__ = [
+    "apply_op",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "arange",
+    "eye",
+    "asarray",
+    "random_normal",
+    "random_uniform",
+    "random_truncated_normal",
+    "random_bernoulli",
+]
+
+
+def _is_fake_leaf(x: Any) -> bool:
+    return isinstance(x, FakeArray)
+
+
+def _is_dynamic(x: Any) -> bool:
+    import numpy as np
+
+    return isinstance(x, (FakeArray, jax.Array, np.ndarray))
+
+
+def apply_op(
+    fn: Callable[..., Any],
+    *args: Any,
+    op_name: Optional[str] = None,
+    claim_device: Any = None,
+    **kwargs: Any,
+):
+    """Apply ``fn`` under the fake/deferred interposition rules above."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_fake_leaf
+    )
+    fakes = [x for x in leaves if isinstance(x, FakeArray)]
+
+    if not fakes and not in_fake_mode():
+        return fn(*args, **kwargs)
+
+    # Partition leaves: arrays (incl. fakes) are dynamic inputs to shape
+    # inference / replay; everything else (dtypes, shape tuples, scalars) is
+    # captured statically in the closure.
+    dyn_idx = [i for i, x in enumerate(leaves) if _is_dynamic(x)]
+    specs = [
+        leaves[i].aval if isinstance(leaves[i], FakeArray) else leaves[i]
+        for i in dyn_idx
+    ]
+
+    # The closure must not retain FakeArray references: a captured FakeArray
+    # pins its producer node for the closure's lifetime, which would force
+    # the replay executor to keep (and device-allocate) every intermediate
+    # output.  Dynamic slots are always overwritten by dyn_vals, so null
+    # them out of the captured template.
+    template = list(leaves)
+    for i in dyn_idx:
+        template[i] = None
+
+    def call_with(dyn_vals):
+        cur = list(template)
+        for i, v in zip(dyn_idx, dyn_vals):
+            cur[i] = v
+        a, k = jax.tree_util.tree_unflatten(treedef, cur)
+        return fn(*a, **k)
+
+    # Shape/dtype propagation via XLA shape inference (no allocation) — the
+    # analog of the reference's redispatch-to-Meta (fake.cc:476-489).
+    out = jax.eval_shape(call_with, specs)
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+
+    # Output device claim: explicit arg, else first fake arg's claim, else
+    # the mode default — the reference's output-device heuristic
+    # (fake.cc:416-432).
+    device = claim_device
+    if device is None and fakes:
+        device = fakes[0].device
+
+    session = current_session()
+    arg_sessions = {f._session for f in fakes if f._session is not None}
+    if len(arg_sessions) > 1:
+        raise RuntimeError(
+            "fake arrays from different deferred_init sessions cannot be "
+            "mixed in one op"
+        )
+
+    name = op_name or getattr(fn, "__name__", None) or "op"
+
+    if session is not None:
+        # Recording. All fake args must be recordable in *this* session —
+        # parity with validateTensorArguments (deferred_init.cc:800-811).
+        if any(f._session is None for f in fakes):
+            raise RuntimeError(
+                f"op {name!r}: argument is a fake array created outside a "
+                "deferred-init context and cannot be recorded"
+            )
+        if arg_sessions and arg_sessions != {session}:
+            raise RuntimeError(
+                f"op {name!r}: argument was recorded in a different "
+                "deferred-init session"
+            )
+
+        closure_dyn = [
+            NodeRef(x._node, x._out_idx) if isinstance(x, FakeArray) else x
+            for x in (leaves[i] for i in dyn_idx)
+        ]
+        deps = [f._node for f in fakes]
+        nid = session.record(
+            name, call_with, (closure_dyn,), {}, out_leaves, out_tree, deps
+        )
+        results = [
+            FakeArray(aval, device, session, nid, i)
+            for i, aval in enumerate(out_leaves)
+        ]
+    else:
+        # Plain fake mode (or ops on leftover fakes outside any mode):
+        # results are fake and unmaterializable.
+        results = [FakeArray(aval, device) for aval in out_leaves]
+
+    return jax.tree_util.tree_unflatten(out_tree, results)
+
+
+def _as_device(device: Any) -> Any:
+    if isinstance(device, str):
+        platform, _, idx = device.partition(":")
+        return FakeDevice(platform, int(idx) if idx else 0)
+    return device
+
+
+# -- creation ops ---------------------------------------------------------
+
+
+def zeros(shape, dtype=jnp.float32, device=None):
+    return apply_op(
+        lambda: jnp.zeros(shape, dtype),
+        op_name="zeros",
+        claim_device=_as_device(device),
+    )
+
+
+def ones(shape, dtype=jnp.float32, device=None):
+    return apply_op(
+        lambda: jnp.ones(shape, dtype),
+        op_name="ones",
+        claim_device=_as_device(device),
+    )
+
+
+def full(shape, fill_value, dtype=None, device=None):
+    return apply_op(
+        lambda: jnp.full(shape, fill_value, dtype),
+        op_name="full",
+        claim_device=_as_device(device),
+    )
+
+
+def empty(shape, dtype=jnp.float32, device=None):
+    # XLA has no uninitialized allocation; zeros compiles to a broadcast,
+    # which is as cheap as it gets.
+    return apply_op(
+        lambda: jnp.zeros(shape, dtype),
+        op_name="empty",
+        claim_device=_as_device(device),
+    )
+
+
+def arange(*args, dtype=None, device=None):
+    return apply_op(
+        lambda: jnp.arange(*args, dtype=dtype),
+        op_name="arange",
+        claim_device=_as_device(device),
+    )
+
+
+def eye(n, m=None, dtype=jnp.float32, device=None):
+    return apply_op(
+        lambda: jnp.eye(n, m, dtype=dtype),
+        op_name="eye",
+        claim_device=_as_device(device),
+    )
+
+
+def asarray(x, dtype=None, device=None):
+    return apply_op(
+        lambda: jnp.asarray(x, dtype=dtype),
+        op_name="asarray",
+        claim_device=_as_device(device),
+    )
+
+
+# -- random ops (counter-based keys => deterministic replay) --------------
+
+
+def random_normal(key, shape, dtype=jnp.float32, device=None):
+    return apply_op(
+        jax.random.normal,
+        key,
+        shape,
+        dtype,
+        op_name="random_normal",
+        claim_device=_as_device(device),
+    )
+
+
+def random_uniform(
+    key, shape, dtype=jnp.float32, minval=0.0, maxval=1.0, device=None
+):
+    return apply_op(
+        jax.random.uniform,
+        key,
+        shape,
+        dtype,
+        minval,
+        maxval,
+        op_name="random_uniform",
+        claim_device=_as_device(device),
+    )
+
+
+def random_truncated_normal(
+    key, lower, upper, shape, dtype=jnp.float32, device=None
+):
+    return apply_op(
+        jax.random.truncated_normal,
+        key,
+        lower,
+        upper,
+        shape,
+        dtype,
+        op_name="random_truncated_normal",
+        claim_device=_as_device(device),
+    )
+
+
+def random_bernoulli(key, p, shape, device=None):
+    return apply_op(
+        jax.random.bernoulli,
+        key,
+        p,
+        shape,
+        op_name="random_bernoulli",
+        claim_device=_as_device(device),
+    )
+
+
+_JNP_CACHE: dict[str, Callable[..., Any]] = {}
+
+
+def __getattr__(name: str):
+    """Expose the whole ``jax.numpy`` surface through the interposition
+    layer: ``ops.matmul``, ``ops.concatenate``, ... work on real and fake
+    arrays alike."""
+    if name in _JNP_CACHE:
+        return _JNP_CACHE[name]
+    target = getattr(jnp, name, None)
+    if target is None:
+        raise AttributeError(f"module 'torchdistx_tpu.ops' has no attribute {name!r}")
+    if not callable(target):
+        return target
+
+    def wrapped(*args, **kwargs):
+        return apply_op(target, *args, op_name=name, **kwargs)
+
+    wrapped.__name__ = name
+    _JNP_CACHE[name] = wrapped
+    return wrapped

@@ -1,0 +1,3 @@
+from .rng import manual_seed, next_rng_key, rng_scope
+
+__all__ = ["manual_seed", "next_rng_key", "rng_scope"]

@@ -1,0 +1,61 @@
+"""Deterministic counter-based RNG stream for parameter initialization.
+
+The reference replays stateful RNG by capturing ``ThreadLocalState`` into
+each recorded op (reference src/cc/torchdistx/deferred_init.cc:205-215,
+261-266).  JAX's counter-based PRNG makes this strictly better: each
+parameter draw folds a monotonically increasing counter into a root key, so
+(a) a deferred construction and an eager construction with the same seed
+produce bit-identical parameters, and (b) replay needs no captured state at
+all — the key is an ordinary closure constant in the recorded op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["manual_seed", "next_rng_key", "rng_scope", "current_seed"]
+
+
+class _RngState(threading.local):
+    def __init__(self) -> None:
+        self.seed = 0
+        self.counter = 0
+        self.root = None
+
+
+_state = _RngState()
+
+
+def manual_seed(seed: int) -> None:
+    """Reset the init RNG stream (torch.manual_seed analog)."""
+    _state.seed = seed
+    _state.counter = 0
+    _state.root = None
+
+
+def current_seed() -> int:
+    return _state.seed
+
+
+def next_rng_key() -> jax.Array:
+    """Next key in the stream.  Creating a key is a host-side O(1) op, so it
+    is safe (and storage-free in any meaningful sense) under fake mode."""
+    if _state.root is None:
+        _state.root = jax.random.PRNGKey(_state.seed)
+    key = jax.random.fold_in(_state.root, _state.counter)
+    _state.counter += 1
+    return key
+
+
+@contextlib.contextmanager
+def rng_scope(seed: int):
+    """Temporarily switch to a fresh stream; restores the outer stream."""
+    prev = (_state.seed, _state.counter, _state.root)
+    manual_seed(seed)
+    try:
+        yield
+    finally:
+        _state.seed, _state.counter, _state.root = prev
