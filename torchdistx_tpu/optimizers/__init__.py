@@ -1,0 +1,3 @@
+from .anyprecision_optimizer import AnyPrecisionAdamW, anyprecision_adamw
+
+__all__ = ["AnyPrecisionAdamW", "anyprecision_adamw"]

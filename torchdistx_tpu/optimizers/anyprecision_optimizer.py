@@ -1,0 +1,190 @@
+"""AnyPrecisionAdamW: AdamW with user-controlled state dtypes and optional
+Kahan-compensated weight updates, enabling pure-BF16 training.
+
+Reference: torchdistx src/python/torchdistx/optimizers/
+anyprecision_optimizer.py — momentum fp32 / variance bf16 / Kahan buffer
+bf16 by default (anyprecision_optimizer.py:27-30); with fp32 states and
+Kahan off it reduces to standard AdamW (:59-60); Kahan summation compensates
+bf16 rounding on the weight update (:169-178).
+
+bf16 is the TPU-native dtype, making this the most naturally TPU-ish
+component of the reference (SURVEY §7).  Provided both as an optax-style
+``GradientTransformation`` (for trainer composition) and as a stateful
+class mirroring the reference's ``torch.optim`` surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["anyprecision_adamw", "AnyPrecisionAdamW"]
+
+
+class _Pair(NamedTuple):
+    update: Any
+    comp: Any
+
+
+class AnyPrecisionAdamWState(NamedTuple):
+    count: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+    compensation: Any  # Kahan buffers, or empty tuple when disabled
+
+
+def anyprecision_adamw(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    *,
+    use_kahan_summation: bool = False,
+    momentum_dtype: Any = jnp.float32,
+    variance_dtype: Any = jnp.bfloat16,
+    compensation_buffer_dtype: Any = jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """Build the transformation.  Defaults mirror the reference
+    (anyprecision_optimizer.py:19-30)."""
+    momentum_dtype = jnp.dtype(momentum_dtype)
+    variance_dtype = jnp.dtype(variance_dtype)
+    compensation_buffer_dtype = jnp.dtype(compensation_buffer_dtype)
+
+    def init(params):
+        exp_avg = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=momentum_dtype), params
+        )
+        exp_avg_sq = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=variance_dtype), params
+        )
+        if use_kahan_summation:
+            compensation = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=compensation_buffer_dtype),
+                params,
+            )
+        else:
+            compensation = ()
+        return AnyPrecisionAdamWState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=exp_avg,
+            exp_avg_sq=exp_avg_sq,
+            compensation=compensation,
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("anyprecision_adamw requires params")
+        count = state.count + 1
+        step = count.astype(jnp.float32)
+        bc1 = 1.0 - b1**step
+        bc2 = 1.0 - b2**step
+
+        def next_m(g, m):
+            gf = g.astype(jnp.float32)
+            m32 = m.astype(jnp.float32) * b1 + gf * (1.0 - b1)
+            return m32.astype(momentum_dtype)
+
+        def next_v(g, v):
+            gf = g.astype(jnp.float32)
+            v32 = v.astype(jnp.float32) * b2 + gf * gf * (1.0 - b2)
+            return v32.astype(variance_dtype)
+
+        new_m = jax.tree_util.tree_map(next_m, grads, state.exp_avg)
+        new_v = jax.tree_util.tree_map(next_v, grads, state.exp_avg_sq)
+
+        lr = learning_rate
+
+        def delta_of(p, m, v):
+            # decoupled weight decay (reference :141-143) + AdamW step
+            pf = p.astype(jnp.float32)
+            denom = jnp.sqrt(v.astype(jnp.float32)) / jnp.sqrt(bc2) + eps
+            adam = -(lr / bc1) * (m.astype(jnp.float32) / denom)
+            if weight_decay != 0.0:
+                adam = adam - lr * weight_decay * pf
+            return adam
+
+        if use_kahan_summation:
+            # Kahan-compensated application in the parameter dtype
+            # (reference :169-178): the compensation buffer accumulates the
+            # rounding residual so long bf16 runs do not lose small updates.
+            # One math pass; results carried in a marker pair so the unzip
+            # cannot be confused with tuple nodes in the params tree itself.
+            def kahan_both(p, m, v, comp):
+                pf = p.astype(jnp.float32)
+                buf = comp.astype(jnp.float32) + delta_of(p, m, v)
+                new_p = (pf + buf).astype(p.dtype)
+                applied = new_p.astype(jnp.float32) - pf
+                return _Pair(
+                    (new_p - p).astype(p.dtype),
+                    (buf - applied).astype(compensation_buffer_dtype),
+                )
+
+            pairs = jax.tree_util.tree_map(
+                kahan_both, params, new_m, new_v, state.compensation
+            )
+            is_pair = lambda x: isinstance(x, _Pair)  # noqa: E731
+            updates = jax.tree_util.tree_map(
+                lambda pr: pr.update, pairs, is_leaf=is_pair
+            )
+            new_comp = jax.tree_util.tree_map(
+                lambda pr: pr.comp, pairs, is_leaf=is_pair
+            )
+        else:
+            updates = jax.tree_util.tree_map(
+                lambda p, m, v: delta_of(p, m, v).astype(p.dtype),
+                params,
+                new_m,
+                new_v,
+            )
+            new_comp = ()
+
+        return updates, AnyPrecisionAdamWState(
+            count=count,
+            exp_avg=new_m,
+            exp_avg_sq=new_v,
+            compensation=new_comp,
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+class AnyPrecisionAdamW:
+    """Stateful wrapper mirroring the reference's optimizer surface:
+    construct with params, call :meth:`step` with grads."""
+
+    def __init__(
+        self,
+        params: Any,
+        lr: float = 1e-3,
+        betas: tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        *,
+        use_kahan_summation: bool = False,
+        momentum_dtype: Any = jnp.float32,
+        variance_dtype: Any = jnp.bfloat16,
+        compensation_buffer_dtype: Any = jnp.bfloat16,
+    ) -> None:
+        self.tx = anyprecision_adamw(
+            lr,
+            betas[0],
+            betas[1],
+            eps,
+            weight_decay,
+            use_kahan_summation=use_kahan_summation,
+            momentum_dtype=momentum_dtype,
+            variance_dtype=variance_dtype,
+            compensation_buffer_dtype=compensation_buffer_dtype,
+        )
+        self.state = self.tx.init(params)
+        self._step = jax.jit(
+            lambda g, s, p: self.tx.update(g, s, p)
+        )
+
+    def step(self, params: Any, grads: Any) -> Any:
+        updates, self.state = self._step(grads, self.state, params)
+        return jax.tree_util.tree_map(lambda p, u: p + u, params, updates)

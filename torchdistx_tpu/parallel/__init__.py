@@ -1,0 +1,23 @@
+from . import collectives
+from .comm_hooks import DefaultState, HookContext, allreduce_hook, noop_hook
+from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
+from .gossip_grad import GossipGraDState, Topology, gossip_grad_hook
+from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
+
+__all__ = [
+    "collectives",
+    "DefaultState",
+    "HookContext",
+    "allreduce_hook",
+    "noop_hook",
+    "ShardedTrainStep",
+    "fsdp_partition_spec",
+    "fsdp_shard_rule",
+    "GossipGraDState",
+    "Topology",
+    "gossip_grad_hook",
+    "create_mesh",
+    "hierarchical_mesh",
+    "mesh_sharding",
+    "replicated",
+]

@@ -1,0 +1,117 @@
+"""Collectives layer: the c10d-primitive surface the reference consumes,
+expressed as XLA collectives over mesh axes.
+
+Mapping (SURVEY §5.8 / §2.4):
+  c10d allreduce            -> ``all_reduce`` (lax.psum / pmean)
+  c10d broadcast            -> ``broadcast`` (masked psum from source)
+  c10d isend/irecv pair     -> ``exchange`` (lax.ppermute pair — GossipGraD's
+                               2-peer exchange maps exactly onto a
+                               CollectivePermute, gossip_grad.py:291-315)
+  c10d reduce_scatter       -> ``reduce_scatter`` (lax.psum_scatter)
+  c10d all_gather           -> ``all_gather`` (lax.all_gather)
+  dist.new_subgroups        -> a mesh axis (parallel.mesh)
+  dist.barrier              -> unnecessary under SPMD/XLA scheduling
+
+These functions are *collective-inside-computation*: they must run inside a
+``shard_map`` (or pmap) region over the named axis.  Pytree-valued inputs
+are supported everywhere, since gradient pytrees are the common operand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "all_reduce",
+    "all_mean",
+    "broadcast",
+    "exchange",
+    "shift",
+    "all_gather",
+    "reduce_scatter",
+    "axis_index",
+    "axis_size",
+]
+
+
+def all_reduce(tree: Any, axis: str) -> Any:
+    """Sum over the mesh axis (c10d allreduce / NCCL AllReduce analog)."""
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis), tree)
+
+
+def all_mean(tree: Any, axis: str) -> Any:
+    """Mean over the mesh axis (the reference's default allreduce hook
+    divides by world size, FSDP default.allreduce_hook)."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)
+
+
+def broadcast(tree: Any, axis: str, source: int = 0) -> Any:
+    """Broadcast ``source``'s value to all members of the axis.
+
+    XLA has no first-class broadcast inside SPMD computations; the idiomatic
+    lowering is mask-and-psum, which XLA recognizes and turns into an
+    efficient collective.
+    """
+    idx = lax.axis_index(axis)
+
+    def bc(x):
+        masked = jnp.where(idx == source, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis)
+
+    return jax.tree_util.tree_map(bc, tree)
+
+
+def exchange(tree: Any, axis: str, send_to: Sequence[int], recv_from: Sequence[int]) -> Any:
+    """Point-to-point exchange: member i sends its value to ``send_to[i]``
+    and receives from ``recv_from[i]`` (the batch_isend_irecv analog).
+
+    ``send_to`` defines the CollectivePermute; ``recv_from`` is accepted for
+    API parity with the reference's peer bookkeeping and validated against
+    it.  A member with ``send_to[i] < 0`` sends nothing and receives zeros
+    (the reference's INVALID_PEER skip, gossip_grad.py:18-23,273-276).
+    """
+    perm = [(i, int(d)) for i, d in enumerate(send_to) if int(d) >= 0]
+    if recv_from is not None:
+        implied = {dst: src for src, dst in perm}
+        for i, src in enumerate(recv_from):
+            if int(src) >= 0 and implied.get(i, None) != int(src):
+                raise ValueError(
+                    f"inconsistent peer lists: member {i} expects to receive "
+                    f"from {src} but the send permutation delivers "
+                    f"{implied.get(i)}"
+                )
+    return jax.tree_util.tree_map(
+        lambda x: lax.ppermute(x, axis, perm), tree
+    )
+
+
+def shift(tree: Any, axis: str, offset: int = 1) -> Any:
+    """Ring shift by ``offset`` (the ring-collective building block)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def all_gather(tree: Any, axis: str, tiled_axis: int = 0) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: lax.all_gather(x, axis, axis=tiled_axis, tiled=True), tree
+    )
+
+
+def reduce_scatter(tree: Any, axis: str, scatter_axis: int = 0) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
+        tree,
+    )
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)

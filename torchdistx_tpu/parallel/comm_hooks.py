@@ -1,0 +1,78 @@
+"""Gradient communication hooks.
+
+The reference plugs into FSDP's ``register_comm_hook(state, hook)``
+(gossip_grad.py:334-389, slowmo_comm.py:30-43).  Here the hook point lives
+in this framework's own sharded train step (parallel.fsdp): after local
+gradients are computed — and reduce-scattered over the shard axis — the
+hook decides how gradients are synchronized across the data-parallel axes.
+
+A hook is ``hook(state, grads, ctx) -> grads`` where
+  - ``state`` is the hook's state object (iteration counter, topology, ...),
+    mirroring the reference's ``DefaultState`` subclasses;
+  - ``grads`` is the gradient pytree (per-device shard view — the hook runs
+    inside ``shard_map``);
+  - ``ctx`` is a :class:`HookContext` naming the mesh axes the hook may
+    reduce over and carrying the traced step counter.
+
+Host-side mutable state (iteration counters) cannot live inside a jitted
+step, so ``state.advance()`` is called by the trainer once per step on the
+host, and per-step values (e.g. the gossip topology index) enter the step
+as arguments — the TPU-native translation of the reference's
+``state.iter += 1`` inside the hook (gossip_grad.py:389).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from . import collectives
+
+__all__ = [
+    "HookContext",
+    "DefaultState",
+    "allreduce_hook",
+    "noop_hook",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HookContext:
+    """Axes available to a hook inside the sharded step."""
+
+    replica_axes: tuple[str, ...]  # axes over which grads must be synced
+    step: Any = None  # traced per-call values (e.g. topology index)
+
+
+class DefaultState:
+    """Base hook state: a host-side iteration counter.
+
+    Parity: FSDP ``default.DefaultState`` as extended by the reference
+    (gossip_grad.py:66-207).
+    """
+
+    def __init__(self) -> None:
+        self.iteration = 0
+
+    def advance(self) -> None:
+        self.iteration += 1
+
+    # per-step traced arguments fed into the jitted step for this hook
+    def step_args(self) -> Any:
+        return None
+
+
+def allreduce_hook(state: DefaultState, grads: Any, ctx: HookContext) -> Any:
+    """Mean-reduce gradients over every replica axis — the default FSDP
+    behavior the reference delegates to (default.allreduce_hook)."""
+    for axis in ctx.replica_axes:
+        grads = collectives.all_mean(grads, axis)
+    return grads
+
+
+def noop_hook(state: DefaultState, grads: Any, ctx: HookContext) -> Any:
+    """No synchronization (debugging / local SGD between averaging steps)."""
+    return grads
+
+
+Hook = Callable[[Any, Any, HookContext], Any]

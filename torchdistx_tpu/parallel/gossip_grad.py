@@ -1,0 +1,160 @@
+"""GossipGraD gradient communication hook (paper arXiv:1803.05880), mapped
+onto XLA collectives.
+
+Reference implementation: torchdistx src/python/torchdistx/gossip_grad.py.
+Per-step pipeline there (gossip_grad.py:334-389): rotate virtual topology
+every ``gossip_period`` steps → intra-node allreduce → master-rank 2-peer
+gossip exchange via batched isend/irecv, ``grad = (grad + recv) * 0.5`` →
+broadcast from node master to the local group.
+
+TPU-native translation:
+  - "node" and "local" process groups -> the ``node``/``local`` mesh axes
+    (parallel.mesh.hierarchical_mesh).
+  - intra-node allreduce -> ``lax.pmean`` over ``local`` (ICI).
+  - the master-only isend/irecv + local broadcast -> a single
+    ``lax.ppermute`` over ``node`` executed by *every* device in the node
+    (SPMD): each (node, local) device exchanges with (peer_node, local).
+    This is mathematically identical to master-exchange-then-broadcast and
+    strictly better on TPU: all local devices' links move shards of the
+    gossip traffic in parallel instead of one master serializing it.
+  - topology rotation is host-side state; the current topology enters the
+    jitted step as a traced index selecting a ``lax.switch`` branch, each
+    branch closing over one static CollectivePermute.
+
+Peer selection parity (gossip_grad.py:210-247):
+  CUBE:          peer = node_rank XOR 2**power, INVALID (skip) if >= n
+  DISSEMINATION: send to (rank + 2**power) % n, recv from (rank - 2**power) % n
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import math
+import random
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import collectives
+from .comm_hooks import DefaultState, HookContext
+
+__all__ = ["Topology", "GossipGraDState", "gossip_grad_hook", "INVALID_PEER"]
+
+INVALID_PEER = -1  # parity: gossip_grad.py:23
+
+
+class Topology(enum.Enum):
+    CUBE = "cube"
+    DISSEMINATION = "dissemination"
+
+
+def _peers(topology: Topology, power: int, num_nodes: int):
+    """Return (send_to, recv_from, valid) lists of length num_nodes."""
+    send, recv, valid = [], [], []
+    stride = 2**power
+    for i in range(num_nodes):
+        if topology is Topology.CUBE:
+            peer = i ^ stride
+            if peer >= num_nodes:
+                send.append(INVALID_PEER)
+                recv.append(INVALID_PEER)
+                valid.append(False)
+            else:
+                send.append(peer)
+                recv.append(peer)
+                valid.append(True)
+        else:
+            send.append((i + stride) % num_nodes)
+            recv.append((i - stride) % num_nodes)
+            valid.append(True)
+    return send, recv, valid
+
+
+class GossipGraDState(DefaultState):
+    """Hook state: topology schedule + iteration bookkeeping.
+
+    Parity with the reference's ``GossipGraDState`` (gossip_grad.py:66-207):
+    seeded shuffled cycle over the ``log2(num_nodes)`` powers,
+    ``gossip_period = ceil(log2(num_nodes))``, and a ``num_modules``
+    correction for trainers that invoke the hook once per wrapped submodule
+    (gossip_grad.py:319-331,373-379; ours calls it once per step, so the
+    default is 1).
+
+    Tests may inject a deterministic schedule by assigning
+    ``state.topology_cycle = itertools.cycle([power, ...])`` — the analog of
+    the reference tests' ``state.topologies = itertools.cycle([...])``
+    (test_comm_hooks_fsdp.py:492-493).
+    """
+
+    def __init__(
+        self,
+        num_nodes: int,
+        *,
+        node_axis: str = "node",
+        local_axis: Optional[str] = "local",
+        topology: Topology = Topology.CUBE,
+        seed: int = 0,
+        gossip_period: Optional[int] = None,
+        num_modules: int = 1,
+    ) -> None:
+        super().__init__()
+        if num_nodes < 2:
+            raise ValueError("GossipGraD needs at least 2 nodes")
+        self.num_nodes = num_nodes
+        self.node_axis = node_axis
+        self.local_axis = local_axis
+        self.topology = topology
+        self.num_powers = max(1, math.ceil(math.log2(num_nodes)))
+        self.gossip_period = gossip_period or self.num_powers
+        self.num_modules = max(1, num_modules)
+        powers = list(range(self.num_powers))
+        random.Random(seed).shuffle(powers)
+        self.topology_cycle: Iterable[int] = itertools.cycle(powers)
+        self._current_power: Optional[int] = None
+        self._rotation_idx = -1
+
+    @property
+    def current_power(self) -> int:
+        """Current topology power; rotates every ``gossip_period`` adjusted
+        steps, drawing lazily from ``topology_cycle`` so injected
+        deterministic schedules take effect from the first step."""
+        adjusted = self.iteration // self.num_modules
+        rotation = adjusted // self.gossip_period
+        if rotation != self._rotation_idx or self._current_power is None:
+            self._current_power = next(iter(self.topology_cycle))
+            self._rotation_idx = rotation
+        return self._current_power
+
+    def step_args(self) -> Any:
+        return jnp.int32(self.current_power)
+
+
+def gossip_grad_hook(state: GossipGraDState, grads: Any, ctx: HookContext) -> Any:
+    """The hook.  Runs inside ``shard_map``; ``ctx.step`` carries the traced
+    topology index from ``state.step_args()``."""
+    if state.local_axis is not None and state.local_axis in ctx.replica_axes:
+        grads = collectives.all_mean(grads, state.local_axis)
+
+    node_axis = state.node_axis
+    num_nodes = state.num_nodes
+
+    def make_branch(power: int):
+        send, recv, valid = _peers(state.topology, power, num_nodes)
+        valid_arr = jnp.asarray(valid)
+
+        def branch(g):
+            received = collectives.exchange(g, node_axis, send, recv)
+            ok = valid_arr[lax.axis_index(node_axis)]
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, (a + b) * 0.5, a), g, received
+            )
+
+        return branch
+
+    branches = [make_branch(p) for p in range(state.num_powers)]
+    if len(branches) == 1:
+        return branches[0](grads)
+    return lax.switch(ctx.step, branches, grads)

@@ -1,0 +1,98 @@
+"""Device-mesh construction and axis conventions.
+
+The reference outsources topology to ``torch.distributed`` process groups
+(intra-node subgroups via ``dist.new_subgroups()``, master groups via
+``dist.new_group`` — reference gossip_grad.py:119,183).  The TPU-native
+equivalent is a ``jax.sharding.Mesh`` whose named axes play the role of
+process groups: an axis IS a subgroup, and collectives over it ride ICI
+(intra-slice) or DCN (cross-slice) depending on how the mesh maps onto the
+physical topology.
+
+Axis conventions used across the framework:
+  - ``dp``    data parallel (gradient reduction)
+  - ``fsdp``  parameter/optimizer sharding (ZeRO-style)
+  - ``tp``    tensor parallel
+  - ``sp``    sequence/context parallel (ring attention)
+  - ``node`` / ``local``  the 2-level hierarchy GossipGraD/SlowMo use:
+    ``local`` = devices within a node (ICI), ``node`` = across nodes (DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "create_mesh",
+    "hierarchical_mesh",
+    "mesh_sharding",
+    "replicated",
+    "local_mesh_size",
+]
+
+
+def create_mesh(
+    axis_sizes: dict[str, int],
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``.
+
+    A size of -1 (at most one axis) absorbs the remaining devices, like a
+    reshape wildcard: ``create_mesh({"dp": -1, "tp": 4})``.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    n = len(devs)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {known}"
+            )
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} wants {total} devices, "
+            f"have {n}"
+        )
+    return Mesh(np.array(devs).reshape(sizes), names)
+
+
+def hierarchical_mesh(
+    num_nodes: int,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The GossipGraD/SlowMo 2-level topology: ``('node', 'local')``.
+
+    Mirrors the reference's emulation of nodes as fixed-size subgroups of
+    devices on one host (reference test_comm_hooks_fsdp.py:476-487).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) % num_nodes != 0:
+        raise ValueError(
+            f"{len(devs)} devices not divisible into {num_nodes} nodes"
+        )
+    return create_mesh(
+        {"node": num_nodes, "local": len(devs) // num_nodes}, devices=devs
+    )
+
+
+def mesh_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_mesh_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

@@ -1,0 +1,10 @@
+from .slowmo_comm import SlowMoState, slowmo_hook
+from .slowmo_optimizer import SlowMomentumOptimizer, replica_mean, slow_momentum
+
+__all__ = [
+    "SlowMoState",
+    "slowmo_hook",
+    "SlowMomentumOptimizer",
+    "slow_momentum",
+    "replica_mean",
+]

@@ -1,0 +1,36 @@
+"""SlowMo communication hook: intra-node-only gradient averaging.
+
+Reference: torchdistx src/python/torchdistx/slowmo/slowmo_comm.py —
+``SlowMoState(subgroup, sync_grads)`` defaulting to intra-node subgroups,
+and ``slowmo_hook`` doing a conditional intra-node allreduce
+(slowmo_comm.py:24-43).  Global synchronization is deferred to the
+SlowMomentumOptimizer's periodic model averaging.
+
+TPU-native: the subgroup is the ``local`` mesh axis; the allreduce is
+``lax.pmean`` over it (ICI-only traffic — no DCN until the periodic
+average).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..parallel import collectives
+from ..parallel.comm_hooks import DefaultState, HookContext
+
+__all__ = ["SlowMoState", "slowmo_hook"]
+
+
+class SlowMoState(DefaultState):
+    def __init__(
+        self, subgroup_axis: Optional[str] = "local", sync_grads: bool = True
+    ) -> None:
+        super().__init__()
+        self.subgroup_axis = subgroup_axis
+        self.sync_grads = sync_grads
+
+
+def slowmo_hook(state: SlowMoState, grads: Any, ctx: HookContext) -> Any:
+    if state.sync_grads and state.subgroup_axis is not None:
+        grads = collectives.all_mean(grads, state.subgroup_axis)
+    return grads
