@@ -1,0 +1,59 @@
+"""Benchmark: deferred_init -> materialize wall-clock (BASELINE.json metric).
+
+Measures config 3's model (GPT-2-large, ~774M params) through the full
+flagship pipeline on the attached accelerator: storage-less deferred
+construction, then whole-model single-compile replay materialization onto
+the device.  ``vs_baseline`` is the north-star budget ratio: the target is
+materializing a model in under 60 s (BASELINE.json config 5); >1.0 means
+faster than budget.
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import time
+
+
+def main() -> None:
+    import jax
+
+    import torchdistx_tpu as tdx
+    from torchdistx_tpu.models import GPT2
+
+    t0 = time.time()
+    tdx.manual_seed(0)
+    model = tdx.deferred_init(GPT2.from_name, "gpt2_large")
+    t_defer = time.time() - t0
+    n_params = model.num_params()
+
+    t0 = time.time()
+    tdx.materialize_module(model)
+    jax.block_until_ready(model.tok_emb.weight)
+    t_mat = time.time() - t0
+
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    total = t_defer + t_mat
+    print(
+        json.dumps(
+            {
+                "metric": "deferred_init_materialize_gpt2_large_wall_s",
+                "value": round(total, 3),
+                "unit": "s",
+                "vs_baseline": round(60.0 / total, 3),
+                "extra": {
+                    "deferred_init_s": round(t_defer, 3),
+                    "materialize_s": round(t_mat, 3),
+                    "params": int(n_params),
+                    "peak_host_rss_gb": round(peak_rss_gb, 3),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

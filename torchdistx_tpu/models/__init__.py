@@ -1,0 +1,20 @@
+from .gpt2 import GPT2, GPT2Config, gpt2_configs
+from .llama import Llama, LlamaConfig, llama_configs
+from .resnet import ResNet, resnet18, resnet50, resnet101
+from .t5 import T5, T5Config, t5_configs
+
+__all__ = [
+    "Llama",
+    "LlamaConfig",
+    "llama_configs",
+    "GPT2",
+    "GPT2Config",
+    "gpt2_configs",
+    "ResNet",
+    "resnet18",
+    "resnet50",
+    "resnet101",
+    "T5",
+    "T5Config",
+    "t5_configs",
+]

@@ -1,0 +1,91 @@
+"""GPT-2 family (BASELINE.json config 3: deferred_init(GPT-2-large) →
+materialize sharded across 8 chips).
+
+Standard GPT-2: learned positional embeddings, pre-LayerNorm blocks, GELU
+MLP, weight-tied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.attention import multihead_attention
+
+__all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    norm_eps: float = 1e-5
+    dtype: object = jnp.float32
+
+
+gpt2_configs = {
+    "tiny": dict(vocab_size=256, n_positions=64, dim=64, n_layers=2, n_heads=4),
+    "gpt2": dict(dim=768, n_layers=12, n_heads=12),
+    "gpt2_medium": dict(dim=1024, n_layers=24, n_heads=16),
+    "gpt2_large": dict(dim=1280, n_layers=36, n_heads=20),
+    "gpt2_xl": dict(dim=1600, n_layers=48, n_heads=25),
+}
+
+
+class GPT2Block(nn.Module):
+    def __init__(self, cfg: GPT2Config):
+        super().__init__()
+        d = cfg.dim
+        self.ln1 = nn.LayerNorm(d, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.attn_qkv = nn.Linear(d, 3 * d, dtype=cfg.dtype)
+        self.attn_out = nn.Linear(d, d, dtype=cfg.dtype)
+        self.ln2 = nn.LayerNorm(d, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.mlp_up = nn.Linear(d, 4 * d, dtype=cfg.dtype)
+        self.mlp_down = nn.Linear(4 * d, d, dtype=cfg.dtype)
+        self.n_heads = cfg.n_heads
+
+    def forward(self, x):
+        b, s, d = x.shape
+        h = self.ln1(x)
+        qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, d // self.n_heads)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = multihead_attention(q, k, v, causal=True).reshape(b, s, d)
+        x = x + self.attn_out(a)
+        h = self.ln2(x)
+        return x + self.mlp_down(F.gelu(self.mlp_up(h)))
+
+
+class GPT2(nn.Module):
+    def __init__(self, cfg: GPT2Config):
+        super().__init__()
+        self.cfg = cfg
+        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.pos_emb = nn.Embedding(cfg.n_positions, cfg.dim, dtype=cfg.dtype)
+        self.blocks = nn.ModuleList([GPT2Block(cfg) for _ in range(cfg.n_layers)])
+        self.ln_f = nn.LayerNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "GPT2":
+        kw = dict(gpt2_configs[name])
+        kw.update(overrides)
+        return cls(GPT2Config(**kw))
+
+    def forward(self, tokens):
+        s = tokens.shape[1]
+        pos = jnp.arange(s)
+        x = self.tok_emb(tokens) + self.pos_emb(pos)[None]
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        # weight-tied head (GPT-2 ties lm_head to tok_emb)
+        return x @ self.tok_emb.weight.T
+
+    def num_params(self) -> int:
+        return sum(p.size for _, p in self.named_parameters())

@@ -1,0 +1,177 @@
+"""Llama-family decoder (the framework's flagship model).
+
+Standard Llama-2 architecture: RMSNorm pre-norm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, tied-free LM head.  The north-star
+config (``llama2_7b``) matches BASELINE.json config 5
+(deferred_init(Llama-2-7B) → sharded materialize → train step).
+
+TPU-first choices: bf16 parameters by default, f32 softmax/norm statistics,
+optional ``jax.checkpoint`` over blocks (rematerialization trades FLOPs for
+HBM), optional ring attention over an ``sp`` mesh axis for long context.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.attention import multihead_attention, ring_attention
+
+__all__ = ["LlamaConfig", "Llama", "llama_configs"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None
+    ffn_dim: Optional[int] = None  # default: Llama SwiGLU sizing
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: object = jnp.bfloat16
+    remat: bool = False  # jax.checkpoint each block
+    sp_axis: Optional[str] = None  # ring attention over this mesh axis
+
+    def __post_init__(self) -> None:
+        if self.n_kv_heads is None:
+            self.n_kv_heads = self.n_heads
+        if self.ffn_dim is None:
+            hidden = int(2 * (4 * self.dim) / 3)
+            multiple = 256
+            self.ffn_dim = multiple * ((hidden + multiple - 1) // multiple)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+llama_configs = {
+    "tiny": dict(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, max_seq_len=128,
+        dtype=jnp.float32,
+    ),
+    "llama2_7b": dict(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+        max_seq_len=4096,
+    ),
+    "llama2_13b": dict(
+        vocab_size=32000, dim=5120, n_layers=40, n_heads=40,
+        max_seq_len=4096,
+    ),
+}
+
+
+def _rope_freqs(head_dim: int, max_seq: int, theta: float) -> jax.Array:
+    inv = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # (seq, head_dim/2)
+    return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)
+
+
+def apply_rope(x: jax.Array, rope: jax.Array, offset=0) -> jax.Array:
+    """x: (B, S, H, D); rope: (max_seq, D/2, 2).  ``offset`` may be traced
+    (sequence-parallel shards pass ``axis_index * local_seq``)."""
+    s = x.shape[1]
+    window = jax.lax.dynamic_slice_in_dim(rope, offset, s, axis=0)
+    cos = window[:, :, 0][None, :, None, :]
+    sin = window[:, :, 1][None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        d, hd = cfg.dim, cfg.head_dim
+        self.cfg = cfg
+        self.wq = nn.Linear(d, cfg.n_heads * hd, bias=False, dtype=cfg.dtype)
+        self.wk = nn.Linear(d, cfg.n_kv_heads * hd, bias=False, dtype=cfg.dtype)
+        self.wv = nn.Linear(d, cfg.n_kv_heads * hd, bias=False, dtype=cfg.dtype)
+        self.wo = nn.Linear(cfg.n_heads * hd, d, bias=False, dtype=cfg.dtype)
+
+    def forward(self, x, rope, pos_offset=0):
+        b, s, _ = x.shape
+        cfg = self.cfg
+        q = self.wq(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = self.wk(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = self.wv(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.sp_axis is not None:
+            # sequence-parallel: this shard holds positions
+            # [axis_index * s, axis_index * s + s)
+            pos_offset = jax.lax.axis_index(cfg.sp_axis) * s
+        q = apply_rope(q, rope, pos_offset)
+        k = apply_rope(k, rope, pos_offset)
+        if cfg.sp_axis is not None:
+            out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
+        else:
+            out = multihead_attention(q, k, v, causal=True)
+        return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))
+
+
+class LlamaMLP(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.w_gate = nn.Linear(cfg.dim, cfg.ffn_dim, bias=False, dtype=cfg.dtype)
+        self.w_up = nn.Linear(cfg.dim, cfg.ffn_dim, bias=False, dtype=cfg.dtype)
+        self.w_down = nn.Linear(cfg.ffn_dim, cfg.dim, bias=False, dtype=cfg.dtype)
+
+    def forward(self, x):
+        return self.w_down(F.silu(self.w_gate(x)) * self.w_up(x))
+
+
+class LlamaBlock(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.attn_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.attn = LlamaAttention(cfg)
+        self.mlp_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, rope):
+        x = x + self.attn(self.attn_norm(x), rope)
+        return x + self.mlp(self.mlp_norm(x))
+
+
+class Llama(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.blocks = nn.ModuleList([LlamaBlock(cfg) for _ in range(cfg.n_layers)])
+        self.norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.lm_head = nn.Linear(cfg.dim, cfg.vocab_size, bias=False, dtype=cfg.dtype)
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "Llama":
+        kw = dict(llama_configs[name])
+        kw.update(overrides)
+        return cls(LlamaConfig(**kw))
+
+    def forward(self, tokens):
+        cfg = self.cfg
+        x = self.tok_emb(tokens)
+        rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        block_fn = (
+            jax.checkpoint(lambda blk, h: blk(h, rope), static_argnums=(0,))
+            if cfg.remat
+            else (lambda blk, h: blk(h, rope))
+        )
+        for blk in self.blocks:
+            x = block_fn(blk, x)
+        x = self.norm(x)
+        return self.lm_head(x)
+
+    def num_params(self) -> int:
+        return sum(p.size for _, p in self.named_parameters())

@@ -1,0 +1,117 @@
+"""ResNet family (BASELINE.json config 2: deferred_init(ResNet-50) →
+materialize on a single TPU chip).
+
+Standard bottleneck ResNet in NCHW; convs lower to XLA
+``conv_general_dilated`` which tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["ResNet", "resnet18", "resnet50", "resnet101"]
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, cout, stride=1, dtype=jnp.float32):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False, dtype=dtype)
+        self.bn1 = nn.BatchNorm2d(cout, dtype=dtype)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1, bias=False, dtype=dtype)
+        self.bn2 = nn.BatchNorm2d(cout, dtype=dtype)
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride=stride, bias=False, dtype=dtype),
+                nn.BatchNorm2d(cout, dtype=dtype),
+            )
+        else:
+            self.down = nn.Sequential()
+
+    def forward(self, x):
+        idt = self.down(x) if len(self.down) else x
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return F.relu(y + idt)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1, dtype=jnp.float32):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False, dtype=dtype)
+        self.bn1 = nn.BatchNorm2d(width, dtype=dtype)
+        self.conv2 = nn.Conv2d(width, width, 3, stride=stride, padding=1, bias=False, dtype=dtype)
+        self.bn2 = nn.BatchNorm2d(width, dtype=dtype)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False, dtype=dtype)
+        self.bn3 = nn.BatchNorm2d(cout, dtype=dtype)
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride=stride, bias=False, dtype=dtype),
+                nn.BatchNorm2d(cout, dtype=dtype),
+            )
+        else:
+            self.down = nn.Sequential()
+
+    def forward(self, x):
+        idt = self.down(x) if len(self.down) else x
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return F.relu(y + idt)
+
+
+class ResNet(nn.Module):
+    def __init__(
+        self,
+        block,
+        layers: Sequence[int],
+        num_classes: int = 1000,
+        dtype=jnp.float32,
+    ):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False, dtype=dtype)
+        self.bn1 = nn.BatchNorm2d(64, dtype=dtype)
+        widths = [64, 128, 256, 512]
+        cin = 64
+        stages = []
+        for i, (w, n) in enumerate(zip(widths, layers)):
+            blocks = []
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                blocks.append(block(cin, w, stride=stride, dtype=dtype))
+                cin = w * block.expansion
+            stages.append(nn.Sequential(*blocks))
+        self.layer1, self.layer2, self.layer3, self.layer4 = stages
+        self.fc = nn.Linear(cin, num_classes, dtype=dtype)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.max_pool2d(x, 3, stride=2, padding=1)
+        for stage in (self.layer1, self.layer2, self.layer3, self.layer4):
+            x = stage(x)
+        x = x.mean(axis=(2, 3))
+        return self.fc(x)
+
+    def num_params(self) -> int:
+        return sum(p.size for _, p in self.named_parameters())
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(Bottleneck, [3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(Bottleneck, [3, 4, 23, 3], **kw)

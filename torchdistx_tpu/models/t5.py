@@ -1,0 +1,192 @@
+"""T5 encoder-decoder family (BASELINE.json config 4: deferred_init(T5-3B) +
+FSDP wrap → materialize → train step).
+
+Standard T5 v1.0 architecture: RMS-style LayerNorm without bias or mean
+subtraction, relative-position-bucket attention bias shared across layers
+(per stack), ReLU MLP, tied embedding scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.attention import multihead_attention
+
+__all__ = ["T5Config", "T5", "t5_configs"]
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    dim: int = 512
+    d_ff: int = 2048
+    d_kv: int = 64
+    n_heads: int = 8
+    n_layers: int = 6  # per stack
+    rel_pos_buckets: int = 32
+    rel_pos_max_dist: int = 128
+    norm_eps: float = 1e-6
+    dtype: object = jnp.float32
+
+
+t5_configs = {
+    "tiny": dict(vocab_size=256, dim=64, d_ff=128, d_kv=16, n_heads=4, n_layers=2),
+    "t5_small": dict(dim=512, d_ff=2048, d_kv=64, n_heads=8, n_layers=6),
+    "t5_base": dict(dim=768, d_ff=3072, d_kv=64, n_heads=12, n_layers=12),
+    "t5_large": dict(dim=1024, d_ff=4096, d_kv=64, n_heads=16, n_layers=24),
+    "t5_3b": dict(dim=1024, d_ff=16384, d_kv=128, n_heads=32, n_layers=24),
+    "t5_11b": dict(dim=1024, d_ff=65536, d_kv=128, n_heads=128, n_layers=24),
+}
+
+
+def _rel_pos_bucket(rel_pos, *, bidirectional: bool, buckets: int, max_dist: int):
+    """T5's relative-position bucketing (log-spaced beyond buckets/2)."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        buckets = buckets // 2
+        ret = jnp.where(n < 0, buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = buckets // 2
+    is_small = n < max_exact
+    log_big = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_dist / max_exact)
+        * (buckets - max_exact)
+    ).astype(jnp.int32)
+    log_big = jnp.minimum(log_big, buckets - 1)
+    return ret + jnp.where(is_small, n, log_big)
+
+
+class T5Attention(nn.Module):
+    def __init__(self, cfg: T5Config, *, has_rel_bias: bool, bidirectional: bool):
+        super().__init__()
+        inner = cfg.n_heads * cfg.d_kv
+        self.cfg = cfg
+        self.bidirectional = bidirectional
+        self.q = nn.Linear(cfg.dim, inner, bias=False, dtype=cfg.dtype)
+        self.k = nn.Linear(cfg.dim, inner, bias=False, dtype=cfg.dtype)
+        self.v = nn.Linear(cfg.dim, inner, bias=False, dtype=cfg.dtype)
+        self.o = nn.Linear(inner, cfg.dim, bias=False, dtype=cfg.dtype)
+        if has_rel_bias:
+            self.rel_bias = nn.Embedding(cfg.rel_pos_buckets, cfg.n_heads, dtype=cfg.dtype)
+        else:
+            self.rel_bias = None
+
+    def _bias(self, sq: int, skv: int):
+        if self.rel_bias is None:
+            return None
+        cfg = self.cfg
+        ctx = jnp.arange(sq)[:, None]
+        mem = jnp.arange(skv)[None, :]
+        bucket = _rel_pos_bucket(
+            mem - ctx,
+            bidirectional=self.bidirectional,
+            buckets=cfg.rel_pos_buckets,
+            max_dist=cfg.rel_pos_max_dist,
+        )
+        return jnp.transpose(self.rel_bias(bucket), (2, 0, 1))  # (H, Sq, Skv)
+
+    def forward(self, x, kv=None, causal=False, bias=None):
+        cfg = self.cfg
+        b, sq, _ = x.shape
+        kv = x if kv is None else kv
+        skv = kv.shape[1]
+        q = self.q(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
+        k = self.k(kv).reshape(b, skv, cfg.n_heads, cfg.d_kv)
+        v = self.v(kv).reshape(b, skv, cfg.n_heads, cfg.d_kv)
+        if bias is None and self.rel_bias is not None:
+            bias = self._bias(sq, skv)
+        # T5 uses unscaled dot products (scale folded into init)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias[None].astype(jnp.float32)
+        if causal:
+            mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+            logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)), bias
+
+
+class T5Block(nn.Module):
+    def __init__(self, cfg: T5Config, *, is_decoder: bool, has_rel_bias: bool):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.ln1 = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.self_attn = T5Attention(
+            cfg, has_rel_bias=has_rel_bias, bidirectional=not is_decoder
+        )
+        if is_decoder:
+            self.ln_cross = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+            self.cross_attn = T5Attention(cfg, has_rel_bias=False, bidirectional=True)
+        self.ln2 = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.wi = nn.Linear(cfg.dim, cfg.d_ff, bias=False, dtype=cfg.dtype)
+        self.wo = nn.Linear(cfg.d_ff, cfg.dim, bias=False, dtype=cfg.dtype)
+
+    def forward(self, x, enc=None, bias=None):
+        a, bias = self.self_attn(self.ln1(x), causal=self.is_decoder, bias=bias)
+        x = x + a
+        if self.is_decoder and enc is not None:
+            c, _ = self.cross_attn(self.ln_cross(x), kv=enc)
+            x = x + c
+        return x + self.wo(F.relu(self.wi(self.ln2(x)))), bias
+
+
+class T5(nn.Module):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.shared_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.enc_blocks = nn.ModuleList(
+            [
+                T5Block(cfg, is_decoder=False, has_rel_bias=(i == 0))
+                for i in range(cfg.n_layers)
+            ]
+        )
+        self.enc_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.dec_blocks = nn.ModuleList(
+            [
+                T5Block(cfg, is_decoder=True, has_rel_bias=(i == 0))
+                for i in range(cfg.n_layers)
+            ]
+        )
+        self.dec_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "T5":
+        kw = dict(t5_configs[name])
+        kw.update(overrides)
+        return cls(T5Config(**kw))
+
+    def encode(self, tokens):
+        x = self.shared_emb(tokens)
+        bias = None
+        for i, blk in enumerate(self.enc_blocks):
+            x, b = blk(x, bias=bias)
+            if i == 0:
+                bias = b  # first layer's rel bias shared by the stack
+        return self.enc_norm(x)
+
+    def forward(self, enc_tokens, dec_tokens):
+        enc = self.encode(enc_tokens)
+        x = self.shared_emb(dec_tokens)
+        bias = None
+        for i, blk in enumerate(self.dec_blocks):
+            x, b = blk(x, enc=enc, bias=bias)
+            if i == 0:
+                bias = b
+        x = self.dec_norm(x)
+        # tied output head with T5's 1/sqrt(dim) scaling
+        return (x * (self.cfg.dim**-0.5)) @ self.shared_emb.weight.T
+
+    def num_params(self) -> int:
+        return sum(p.size for _, p in self.named_parameters())

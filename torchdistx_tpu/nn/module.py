@@ -103,7 +103,8 @@ class Module:
 
     def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
         for name, p in self._parameters.items():
-            yield (f"{prefix}.{name}" if prefix else name), p
+            if p is not None:  # register_parameter(name, None) placeholders
+                yield (f"{prefix}.{name}" if prefix else name), p
         for cname, child in self._modules.items():
             sub = f"{prefix}.{cname}" if prefix else cname
             yield from child.named_parameters(sub)
@@ -114,7 +115,8 @@ class Module:
 
     def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
         for name, b in self._buffers.items():
-            yield (f"{prefix}.{name}" if prefix else name), b
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
         for cname, child in self._modules.items():
             sub = f"{prefix}.{cname}" if prefix else cname
             yield from child.named_buffers(sub)

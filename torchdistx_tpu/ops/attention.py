@@ -1,0 +1,128 @@
+"""Attention ops.
+
+``multihead_attention`` is the single-device path: plain einsum + softmax,
+which XLA fuses onto the MXU.  ``ring_attention`` is the sequence-parallel
+path: Q stays put while K/V blocks rotate around the ``sp`` mesh axis via
+``lax.ppermute`` (ICI neighbor exchanges), combined with an online-softmax
+accumulator — blockwise/ring attention a la Liu et al., the capability the
+reference lacks entirely (SURVEY §5.7 calls it green-field).
+
+Shapes follow (batch, seq, heads, head_dim) throughout.  GQA is supported
+by passing fewer KV heads; they are broadcast over query-head groups.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["multihead_attention", "ring_attention"]
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def multihead_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq != hkv:
+        k = _repeat_kv(k, hq // hkv)
+        v = _repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # f32 softmax accumulation regardless of input dtype (TPU practice)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over sequence shards.  Must run inside ``shard_map``
+    with the sequence dim sharded over ``axis``.
+
+    Each of the N ring steps attends Q's local block against one K/V block,
+    then rotates K/V to the next neighbor (``lax.ppermute`` — a pure ICI
+    neighbor hop).  The online-softmax state (running max, running sum,
+    weighted accumulator) makes the result exactly equal to full attention.
+
+    Causality is handled blockwise: with Q-block index ``i`` and the K/V
+    block currently held being ``j``, the block is fully visible when
+    ``j < i``, diagonal (``j == i``) applies the local causal mask, and
+    future blocks contribute nothing.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    # GQA: keep K/V at hkv heads while they travel the ring (1/n_rep the
+    # ppermute bytes — the whole point of GQA on the long-context path) and
+    # broadcast over query-head groups only inside each local block step.
+    n_rep = hq // hkv
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    neg_inf = jnp.float32(-1e30)
+    local_mask = jnp.tril(jnp.ones((sq, skv), bool))
+
+    def block(carry, _):
+        acc, row_max, row_sum, kb, vb, j = carry
+        kb_full = _repeat_kv(kb, n_rep)
+        vb_full = _repeat_kv(vb, n_rep)
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, kb_full).astype(jnp.float32)
+            * scale_
+        )
+        if causal:
+            visible = jnp.where(
+                j < idx,
+                jnp.ones((sq, skv), bool),
+                jnp.where(j == idx, local_mask, jnp.zeros((sq, skv), bool)),
+            )
+            logits = jnp.where(visible, logits, neg_inf)
+        blk_max = jnp.max(logits, axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(logits - new_max[..., None])
+        new_sum = row_sum * correction + probs.sum(axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", probs, vb_full.astype(jnp.float32)
+        )
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        j = lax.ppermute(j, axis, perm)
+        return (acc, new_max, new_sum, kb, vb, j), None
+
+    acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    max0 = jnp.full((b, hq, sq), neg_inf)
+    sum0 = jnp.zeros((b, hq, sq), jnp.float32)
+    (acc, row_max, row_sum, _, _, _), _ = lax.scan(
+        block, (acc0, max0, sum0, k, v, idx), None, length=n
+    )
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

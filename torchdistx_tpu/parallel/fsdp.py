@@ -114,6 +114,9 @@ class ShardedTrainStep:
     hook_state: Optional[DefaultState] = None
     batch_axes: Optional[tuple[str, ...]] = None
     divergent_replicas: bool = False
+    # full PartitionSpec for batch leaves (overrides batch_axes-on-dim0);
+    # e.g. P('dp', 'sp') to shard tokens over batch AND sequence axes
+    batch_spec: Optional[P] = None
 
     def __post_init__(self) -> None:
         if self.hook_state is None:
@@ -191,7 +194,9 @@ class ShardedTrainStep:
         mesh = self.mesh
         shard_axis = self.shard_axis
         all_axes = tuple(mesh.axis_names)
-        batch_spec = P(self.batch_axes)
+        batch_spec = (
+            self.batch_spec if self.batch_spec is not None else P(self.batch_axes)
+        )
         specs = jax.tree_util.tree_map(self.param_spec, params)
         flat_specs, spec_tree = jax.tree_util.tree_flatten(
             specs, is_leaf=lambda x: isinstance(x, P)
@@ -235,10 +240,13 @@ class ShardedTrainStep:
         # hook owns those) nor the shard axis (psum_scatter owns that).
         # Without this, e.g. divergent-gossip over ('node','local') batches
         # would silently drop all but one local device's data.
+        data_axes: list[str] = []
+        for entry in batch_spec:
+            if entry is None:
+                continue
+            data_axes.extend(entry if isinstance(entry, tuple) else (entry,))
         grad_reduce_axes = tuple(
-            ax
-            for ax in self.batch_axes
-            if ax not in ctx_axes and ax != shard_axis
+            ax for ax in data_axes if ax not in ctx_axes and ax != shard_axis
         )
 
         def grad_part(p_shards, batch, hook_step):
