@@ -7,11 +7,10 @@ API parity with the reference (src/python/torchdistx/deferred_init.py):
 
 The TPU-native twist the reference lacks (SURVEY §7 "Materialize-to-device"):
 ``materialize_module(module, sharding_rule=...)`` replays each parameter's
-init subgraph inside one jitted computation whose ``out_shardings`` place the
-result directly into sharded device buffers across a ``jax.sharding.Mesh`` —
-a multi-billion-parameter model is constructed on host with zero array
-storage and materialized straight onto a pod without ever holding a full
-copy in host RAM.
+init subgraph directly on device and places it straight into sharded buffers
+across a ``jax.sharding.Mesh`` — a multi-billion-parameter model is
+constructed on host with zero array storage and materialized onto a pod
+without ever holding a full copy in host RAM.
 """
 
 from __future__ import annotations
@@ -124,11 +123,12 @@ def materialize_module(
     placement) — the sharded-materialization capability that is this
     framework's north star.
 
-    Unlike the reference, which replays per tensor eagerly
-    (deferred_init.cc:506-528), the whole module's init graph is replayed as
-    ONE jitted XLA program with per-parameter ``out_shardings`` — one
-    compile for the entire model, with every parameter born directly in its
-    target (possibly sharded) device buffers.
+    Unlike the reference, which replays once per tensor
+    (deferred_init.cc:506-528), the whole module's init graph is replayed in
+    a single pass, with every parameter born directly in its target
+    (possibly sharded) device buffers and intermediate buffers freed at
+    their last use — host RAM and device memory stay at O(params), not
+    O(replay graph).
     """
     entries: list[tuple[dict, str, str, FakeArray]] = []
     _collect_entries(module, "", buffers_only, check_fn, entries)

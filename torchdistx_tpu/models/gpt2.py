@@ -79,6 +79,12 @@ class GPT2(nn.Module):
 
     def forward(self, tokens):
         s = tokens.shape[1]
+        if s > self.cfg.n_positions:
+            # jnp.take clamps out-of-range indices silently; fail loudly
+            raise ValueError(
+                f"sequence length {s} exceeds n_positions="
+                f"{self.cfg.n_positions}"
+            )
         pos = jnp.arange(s)
         x = self.tok_emb(tokens) + self.pos_emb(pos)[None]
         for blk in self.blocks:
@@ -86,6 +92,3 @@ class GPT2(nn.Module):
         x = self.ln_f(x)
         # weight-tied head (GPT-2 ties lm_head to tok_emb)
         return x @ self.tok_emb.weight.T
-
-    def num_params(self) -> int:
-        return sum(p.size for _, p in self.named_parameters())

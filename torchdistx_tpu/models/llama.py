@@ -172,6 +172,3 @@ class Llama(nn.Module):
             x = block_fn(blk, x)
         x = self.norm(x)
         return self.lm_head(x)
-
-    def num_params(self) -> int:
-        return sum(p.size for _, p in self.named_parameters())

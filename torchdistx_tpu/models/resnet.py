@@ -101,9 +101,6 @@ class ResNet(nn.Module):
         x = x.mean(axis=(2, 3))
         return self.fc(x)
 
-    def num_params(self) -> int:
-        return sum(p.size for _, p in self.named_parameters())
-
 
 def resnet18(**kw) -> ResNet:
     return ResNet(BasicBlock, [2, 2, 2, 2], **kw)

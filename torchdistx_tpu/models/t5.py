@@ -187,6 +187,3 @@ class T5(nn.Module):
         x = self.dec_norm(x)
         # tied output head with T5's 1/sqrt(dim) scaling
         return (x * (self.cfg.dim**-0.5)) @ self.shared_emb.weight.T
-
-    def num_params(self) -> int:
-        return sum(p.size for _, p in self.named_parameters())

@@ -169,6 +169,10 @@ class Module:
     def eval(self) -> "Module":
         return self.train(False)
 
+    def num_params(self) -> int:
+        """Total parameter count (works on fake and real parameters)."""
+        return sum(p.size for _, p in self.named_parameters())
+
     # -- execution ---------------------------------------------------------
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
