@@ -1,0 +1,24 @@
+"""Build wheel including the native core.
+
+The reference drives CMake from setuptools and installs the CMake artifacts
+into the wheel (reference setup.py:43-136).  Here the native core is one
+translation unit, so the build command simply invokes its Makefile and ships
+the resulting shared library (with a source-build fallback on import for
+sdist installs — torchdistx_tpu/_C/__init__.py).
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_native(build_py):
+    def run(self):
+        csrc = Path(__file__).parent / "torchdistx_tpu" / "csrc"
+        subprocess.run(["make", "-s", "-C", str(csrc)], check=True)
+        super().run()
+
+
+setup(cmdclass={"build_py": build_native})

@@ -1,0 +1,131 @@
+"""Checkpoint round-trips, incl. the reference's map_location-style
+cross-placement restore (test_comm_hooks_fsdp.py:262-331 analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.optimizers import anyprecision_adamw
+from torchdistx_tpu.slowmo import SlowMomentumOptimizer
+from torchdistx_tpu.utils.checkpoint import (
+    load_module,
+    restore_checkpoint,
+    save_checkpoint,
+    save_module,
+)
+
+
+def test_pytree_roundtrip(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4)},
+        "step": jnp.asarray(7),
+    }
+    save_checkpoint(str(tmp_path / "ck"), state)
+    out = restore_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"]))
+    assert int(out["step"]) == 7
+
+
+def test_restore_into_sharding(tmp_path, mesh8):
+    # save replicated, restore sharded — the map_location analog
+    state = {"w": jnp.arange(64.0).reshape(8, 8)}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    sh = NamedSharding(mesh8, P("fsdp"))
+    out = restore_checkpoint(str(tmp_path / "ck"), shardings={"w": sh})
+    assert out["w"].sharding.is_equivalent_to(sh, 2)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(state["w"]))
+
+
+def test_module_roundtrip_with_sharding_rule(tmp_path, mesh8):
+    tdx.manual_seed(0)
+    m = nn.Linear(16, 8)
+    save_module(str(tmp_path / "mod"), m)
+
+    tdx.manual_seed(99)  # different init; load must overwrite
+    m2 = nn.Linear(16, 8)
+
+    def rule(path, meta):
+        if len(meta.shape) == 2 and meta.shape[0] % 8 == 0:
+            return NamedSharding(mesh8, P("fsdp"))
+        return None
+
+    load_module(str(tmp_path / "mod"), m2, sharding_rule=rule)
+    np.testing.assert_allclose(
+        np.asarray(m2._parameters["weight"]), np.asarray(m._parameters["weight"])
+    )
+    # weight (8, 16) matched the rule -> restored FSDP-sharded over 8 devices
+    assert len(m2._parameters["weight"].sharding.device_set) == 8
+    # bias (8,) is 1-d -> rule returned None -> default placement
+    np.testing.assert_allclose(
+        np.asarray(m2._parameters["bias"]), np.asarray(m._parameters["bias"])
+    )
+
+
+def test_restore_like_casts_dtype(tmp_path):
+    state = {"w": jnp.ones((4, 4), jnp.float32)}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    like = {"w": jax.ShapeDtypeStruct((4, 4), jnp.bfloat16)}
+    out = restore_checkpoint(str(tmp_path / "ck"), like=like)
+    assert out["w"].dtype == jnp.bfloat16
+
+
+def test_restore_like_structure_mismatch(tmp_path):
+    save_checkpoint(str(tmp_path / "ck"), {"w": jnp.ones((2,))})
+    with pytest.raises(ValueError, match="does not match"):
+        restore_checkpoint(
+            str(tmp_path / "ck"),
+            like={"w": jnp.ones((2,)), "extra": jnp.ones((1,))},
+        )
+
+
+def test_load_module_strict_mismatch(tmp_path):
+    tdx.manual_seed(0)
+    m = nn.Linear(4, 4)
+    save_module(str(tmp_path / "mod"), m)
+    other = nn.Linear(4, 4, bias=False)
+    with pytest.raises(KeyError, match="mismatch"):
+        load_module(str(tmp_path / "mod"), other)
+    load_module(str(tmp_path / "mod"), other, strict=False)  # opt-out works
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    params = {"w": jnp.ones((4, 4))}
+    tx = anyprecision_adamw(1e-2, use_kahan_summation=True)
+    s = tx.init(params)
+    g = {"w": jnp.full((4, 4), 0.1)}
+    u, s = tx.update(g, s, params)
+    save_checkpoint(str(tmp_path / "opt"), {"state": s})
+    out = restore_checkpoint(str(tmp_path / "opt"))
+    np.testing.assert_allclose(
+        np.asarray(out["state"]["exp_avg"]["w"]), np.asarray(s.exp_avg["w"])
+    )
+    assert int(out["state"]["count"]) == 1
+
+
+def test_slowmo_state_dict_checkpoint(tmp_path):
+    params = {"w": jnp.ones((4,))}
+    opt = SlowMomentumOptimizer(params, optax.sgd(0.1), slowmo_freq=5, base_lr=0.1)
+    params = opt.step(params, {"w": jnp.full((4,), 0.2)})
+    sd = opt.state_dict()
+    save_checkpoint(str(tmp_path / "slowmo"), sd)
+    restored = restore_checkpoint(str(tmp_path / "slowmo"))
+    opt2 = SlowMomentumOptimizer({"w": jnp.zeros((4,))}, optax.sgd(0.1), base_lr=0.1)
+    # orbax restores the NamedTuple state as nested dicts; rebuild
+    from torchdistx_tpu.slowmo.slowmo_optimizer import SlowMomentumState
+
+    restored["state"] = SlowMomentumState(
+        count=restored["state"]["count"],
+        base_state=opt2.state.base_state,
+        prev_params=restored["state"]["prev_params"],
+        slow_momentum=restored["state"]["slow_momentum"],
+    )
+    opt2.load_state_dict(restored)
+    assert opt2.slowmo_freq == 5
+    np.testing.assert_allclose(
+        np.asarray(opt2.state.prev_params["w"]), np.ones(4)
+    )

@@ -18,18 +18,26 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(os.path.dirname(_HERE), "csrc")
-_LIB_PATH = os.path.join(_HERE, "libtdxgraph.so")
+# TDX_NATIVE_LIB selects a sanitizer build (e.g. libtdxgraph-asan.so built
+# with `make SANITIZE=asan`) — see scripts/run-sanitized-tests.
+_LIB_NAME = os.environ.get("TDX_NATIVE_LIB", "libtdxgraph.so")
+_LIB_PATH = os.path.join(_HERE, _LIB_NAME)
 
 _build_lock = threading.Lock()
 
 
 def _build() -> None:
-    subprocess.run(
-        ["make", "-s", "-C", _CSRC],
-        check=True,
-        capture_output=True,
-        text=True,
-    )
+    cmd = ["make", "-s", "-C", _CSRC]
+    for sanitizer in ("asan", "ubsan", "tsan"):
+        if _LIB_NAME.endswith(f"-{sanitizer}.so"):
+            cmd.append(f"SANITIZE={sanitizer}")
+            break
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"building the tdx native core failed "
+            f"(command: {' '.join(cmd)}):\n{proc.stdout}\n{proc.stderr}"
+        )
 
 
 def _load() -> ctypes.CDLL:
