@@ -1,0 +1,13 @@
+from .torch_interop import (
+    from_torch_state_dict,
+    gpt2_key_map,
+    llama_key_map,
+    t5_key_map,
+)
+
+__all__ = [
+    "from_torch_state_dict",
+    "gpt2_key_map",
+    "llama_key_map",
+    "t5_key_map",
+]

@@ -1,0 +1,204 @@
+"""torch / HuggingFace interop: load pretrained state dicts into this
+framework's models.
+
+A user of the reference ecosystem holds weights as torch state dicts
+(HF ``transformers`` checkpoints).  ``from_torch_state_dict`` streams those
+tensors one at a time — convert to numpy, optionally transpose, then
+``device_put`` straight into the target (possibly sharded) placement — so
+host RAM stays at one tensor's footprint, mirroring the memory discipline
+of sharded materialization.
+
+Key maps are provided for the three HF transformer families this framework
+ships (GPT-2, Llama, T5).  Each map is ``ours -> (theirs, transform)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "from_torch_state_dict",
+    "gpt2_key_map",
+    "llama_key_map",
+    "t5_key_map",
+]
+
+Transform = Optional[Callable[[np.ndarray], np.ndarray]]
+KeyMap = dict[str, tuple[str, Transform]]
+
+_T = lambda a: a.T  # noqa: E731  (HF Conv1D stores (in, out))
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().cpu()
+        # numpy lacks bfloat16: round-trip through float32
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+def from_torch_state_dict(
+    module: Any,
+    state_dict: dict[str, Any],
+    key_map: KeyMap,
+    *,
+    sharding_rule: Optional[Callable[[str, Any], Any]] = None,
+    dtype: Any = None,
+    strict: bool = True,
+) -> Any:
+    """Load a torch state dict into ``module`` in place.
+
+    Args:
+      key_map: ``{our_name: (torch_name, transform|None)}``.
+      sharding_rule: per-entry target sharding (same rule shape as
+        ``materialize_module``); tensors are placed as they stream.
+      dtype: optional cast applied to every tensor (e.g. ``jnp.bfloat16``).
+      strict: raise if a mapped torch key is missing.
+    """
+    own = dict(module.state_dict())
+    missing = [k for k in key_map if k not in own]
+    if missing:
+        raise KeyError(f"key_map targets not in module: {missing[:5]}")
+    for ours, (theirs, transform) in key_map.items():
+        if theirs not in state_dict:
+            if strict:
+                raise KeyError(f"torch state dict is missing {theirs!r}")
+            continue
+        arr = _to_numpy(state_dict[theirs])
+        if transform is not None:
+            arr = transform(arr)
+        expected = own[ours]
+        if tuple(arr.shape) != tuple(expected.shape):
+            raise ValueError(
+                f"{ours}: shape {arr.shape} from {theirs!r} does not match "
+                f"module shape {tuple(expected.shape)}"
+            )
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        elif hasattr(expected, "dtype"):
+            arr = arr.astype(expected.dtype)
+        sharding = sharding_rule(ours, expected) if sharding_rule else None
+        value = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
+        module._set_by_path(ours, value)
+        del arr
+    return module
+
+
+def gpt2_key_map(n_layers: int) -> KeyMap:
+    """HF ``GPT2LMHeadModel`` (``transformer.*``) -> our :class:`GPT2`.
+
+    HF's Conv1D stores weights (in, out); our Linear stores (out, in),
+    hence the transposes.
+    """
+    m: KeyMap = {
+        "tok_emb.weight": ("transformer.wte.weight", None),
+        "pos_emb.weight": ("transformer.wpe.weight", None),
+        "ln_f.weight": ("transformer.ln_f.weight", None),
+        "ln_f.bias": ("transformer.ln_f.bias", None),
+    }
+    for i in range(n_layers):
+        h, b = f"transformer.h.{i}", f"blocks.{i}"
+        m.update(
+            {
+                f"{b}.ln1.weight": (f"{h}.ln_1.weight", None),
+                f"{b}.ln1.bias": (f"{h}.ln_1.bias", None),
+                f"{b}.attn_qkv.weight": (f"{h}.attn.c_attn.weight", _T),
+                f"{b}.attn_qkv.bias": (f"{h}.attn.c_attn.bias", None),
+                f"{b}.attn_out.weight": (f"{h}.attn.c_proj.weight", _T),
+                f"{b}.attn_out.bias": (f"{h}.attn.c_proj.bias", None),
+                f"{b}.ln2.weight": (f"{h}.ln_2.weight", None),
+                f"{b}.ln2.bias": (f"{h}.ln_2.bias", None),
+                f"{b}.mlp_up.weight": (f"{h}.mlp.c_fc.weight", _T),
+                f"{b}.mlp_up.bias": (f"{h}.mlp.c_fc.bias", None),
+                f"{b}.mlp_down.weight": (f"{h}.mlp.c_proj.weight", _T),
+                f"{b}.mlp_down.bias": (f"{h}.mlp.c_proj.bias", None),
+            }
+        )
+    return m
+
+
+def llama_key_map(n_layers: int) -> KeyMap:
+    """HF ``LlamaForCausalLM`` (``model.*``) -> our :class:`Llama`.
+
+    Both sides store Linear weights (out, in); the RoPE conventions also
+    match (rotate-half), so the map is 1:1 renames.
+    """
+    m: KeyMap = {
+        "tok_emb.weight": ("model.embed_tokens.weight", None),
+        "norm.weight": ("model.norm.weight", None),
+        "lm_head.weight": ("lm_head.weight", None),
+    }
+    for i in range(n_layers):
+        h, b = f"model.layers.{i}", f"blocks.{i}"
+        m.update(
+            {
+                f"{b}.attn_norm.weight": (f"{h}.input_layernorm.weight", None),
+                f"{b}.attn.wq.weight": (f"{h}.self_attn.q_proj.weight", None),
+                f"{b}.attn.wk.weight": (f"{h}.self_attn.k_proj.weight", None),
+                f"{b}.attn.wv.weight": (f"{h}.self_attn.v_proj.weight", None),
+                f"{b}.attn.wo.weight": (f"{h}.self_attn.o_proj.weight", None),
+                f"{b}.mlp_norm.weight": (
+                    f"{h}.post_attention_layernorm.weight",
+                    None,
+                ),
+                f"{b}.mlp.w_gate.weight": (f"{h}.mlp.gate_proj.weight", None),
+                f"{b}.mlp.w_up.weight": (f"{h}.mlp.up_proj.weight", None),
+                f"{b}.mlp.w_down.weight": (f"{h}.mlp.down_proj.weight", None),
+            }
+        )
+    return m
+
+
+def t5_key_map(n_layers: int) -> KeyMap:
+    """HF ``T5Model``/``T5ForConditionalGeneration`` -> our :class:`T5`."""
+    m: KeyMap = {
+        "shared_emb.weight": ("shared.weight", None),
+        "enc_norm.weight": ("encoder.final_layer_norm.weight", None),
+        "dec_norm.weight": ("decoder.final_layer_norm.weight", None),
+    }
+    for i in range(n_layers):
+        e, b = f"encoder.block.{i}", f"enc_blocks.{i}"
+        m.update(
+            {
+                f"{b}.ln1.weight": (f"{e}.layer.0.layer_norm.weight", None),
+                f"{b}.self_attn.q.weight": (f"{e}.layer.0.SelfAttention.q.weight", None),
+                f"{b}.self_attn.k.weight": (f"{e}.layer.0.SelfAttention.k.weight", None),
+                f"{b}.self_attn.v.weight": (f"{e}.layer.0.SelfAttention.v.weight", None),
+                f"{b}.self_attn.o.weight": (f"{e}.layer.0.SelfAttention.o.weight", None),
+                f"{b}.ln2.weight": (f"{e}.layer.1.layer_norm.weight", None),
+                f"{b}.wi.weight": (f"{e}.layer.1.DenseReluDense.wi.weight", None),
+                f"{b}.wo.weight": (f"{e}.layer.1.DenseReluDense.wo.weight", None),
+            }
+        )
+        d, c = f"decoder.block.{i}", f"dec_blocks.{i}"
+        m.update(
+            {
+                f"{c}.ln1.weight": (f"{d}.layer.0.layer_norm.weight", None),
+                f"{c}.self_attn.q.weight": (f"{d}.layer.0.SelfAttention.q.weight", None),
+                f"{c}.self_attn.k.weight": (f"{d}.layer.0.SelfAttention.k.weight", None),
+                f"{c}.self_attn.v.weight": (f"{d}.layer.0.SelfAttention.v.weight", None),
+                f"{c}.self_attn.o.weight": (f"{d}.layer.0.SelfAttention.o.weight", None),
+                f"{c}.ln_cross.weight": (f"{d}.layer.1.layer_norm.weight", None),
+                f"{c}.cross_attn.q.weight": (f"{d}.layer.1.EncDecAttention.q.weight", None),
+                f"{c}.cross_attn.k.weight": (f"{d}.layer.1.EncDecAttention.k.weight", None),
+                f"{c}.cross_attn.v.weight": (f"{d}.layer.1.EncDecAttention.v.weight", None),
+                f"{c}.cross_attn.o.weight": (f"{d}.layer.1.EncDecAttention.o.weight", None),
+                f"{c}.ln2.weight": (f"{d}.layer.2.layer_norm.weight", None),
+                f"{c}.wi.weight": (f"{d}.layer.2.DenseReluDense.wi.weight", None),
+                f"{c}.wo.weight": (f"{d}.layer.2.DenseReluDense.wo.weight", None),
+            }
+        )
+    m["enc_blocks.0.self_attn.rel_bias.weight"] = (
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+        None,
+    )
+    m["dec_blocks.0.self_attn.rel_bias.weight"] = (
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+        None,
+    )
+    return m

@@ -8,7 +8,6 @@ MLP, weight-tied LM head.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax.numpy as jnp
 

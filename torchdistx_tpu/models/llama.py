@@ -13,7 +13,6 @@ HBM), optional ring attention over an ``sp`` mesh axis for long context.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional
 
 import jax

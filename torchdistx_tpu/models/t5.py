@@ -9,14 +9,11 @@ subtraction, relative-position-bucket attention bias shared across layers
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
-from ..ops.attention import multihead_attention
 
 __all__ = ["T5Config", "T5", "t5_configs"]
 

@@ -12,7 +12,7 @@ parameter pytree for the duration of one forward so the whole step can be
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Iterator, Optional
 
 import jax
 

@@ -15,7 +15,7 @@ class mirroring the reference's ``torch.optim`` surface.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -3,6 +3,7 @@ from .comm_hooks import DefaultState, HookContext, allreduce_hook, noop_hook
 from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
 from .gossip_grad import GossipGraDState, Topology, gossip_grad_hook
 from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
+from .tp import GSPMDTrainStep, llama_tp_rule, tp_shard_rule
 
 __all__ = [
     "collectives",
@@ -20,4 +21,7 @@ __all__ = [
     "hierarchical_mesh",
     "mesh_sharding",
     "replicated",
+    "GSPMDTrainStep",
+    "llama_tp_rule",
+    "tp_shard_rule",
 ]

@@ -24,7 +24,7 @@ as arguments — the TPU-native translation of the reference's
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 from . import collectives
 

@@ -1,0 +1,122 @@
+"""Tensor parallelism via GSPMD sharding annotations.
+
+The reference contains no TP at all (SURVEY §2.4 marks it absent); this is
+part of the host capability set a TPU framework must own.  The TPU-native
+recipe (the scaling-book approach) is *not* manual collective insertion:
+pick a mesh, annotate parameter shardings (Megatron-style column/row
+splits), and let XLA's SPMD partitioner insert the all-gathers /
+reduce-scatters on ICI.
+
+Two pieces:
+  - pattern-based sharding rules (``tp_shard_rule``) usable directly as
+    ``materialize_module(sharding_rule=...)`` — parameters are *born*
+    TP-sharded (optionally 2D TP x FSDP);
+  - ``GSPMDTrainStep``: a jitted train step driven purely by those
+    annotations.  Comm hooks live on the ``shard_map`` path
+    (``ShardedTrainStep``); this path is the compiler-scheduled one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .fsdp import fsdp_partition_spec
+
+__all__ = ["tp_shard_rule", "llama_tp_rule", "GSPMDTrainStep"]
+
+
+def tp_shard_rule(
+    mesh: Mesh,
+    patterns: Sequence[tuple[str, P]],
+    *,
+    default_axis: Optional[str] = None,
+) -> Callable[[str, Any], NamedSharding]:
+    """Build a ``sharding_rule(path, like) -> NamedSharding`` from
+    ``(regex, PartitionSpec)`` pairs (first match wins).
+
+    Unmatched parameters are replicated, or FSDP-sharded over
+    ``default_axis`` when given.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in patterns]
+
+    def rule(path: str, like: Any) -> NamedSharding:
+        for rx, spec in compiled:
+            if rx.search(path):
+                return NamedSharding(mesh, spec)
+        if default_axis is not None:
+            return NamedSharding(
+                mesh, fsdp_partition_spec(like.shape, mesh, default_axis)
+            )
+        return NamedSharding(mesh, P())
+
+    return rule
+
+
+def llama_tp_rule(
+    mesh: Mesh,
+    tp_axis: str = "tp",
+    fsdp_axis: Optional[str] = None,
+) -> Callable[[str, Any], NamedSharding]:
+    """Megatron-style TP layout for :class:`~torchdistx_tpu.models.Llama`.
+
+    Column-parallel (shard output features) for qkv and MLP up/gate;
+    row-parallel (shard input features) for the attention output and MLP
+    down projections — so each block needs exactly one reduce per
+    sub-layer, which XLA inserts.  Embedding and head shard over vocab.
+    With ``fsdp_axis``, the other matrix dim is additionally FSDP-sharded
+    (2D TP x FSDP).
+    """
+    f = fsdp_axis  # may be None -> replicated on that dim
+    patterns = [
+        (r"\.(wq|wk|wv)\.weight$", P(tp_axis, f)),
+        (r"\.wo\.weight$", P(f, tp_axis)),
+        (r"\.(w_gate|w_up)\.weight$", P(tp_axis, f)),
+        (r"\.w_down\.weight$", P(f, tp_axis)),
+        (r"tok_emb\.weight$", P(tp_axis, f)),
+        (r"lm_head\.weight$", P(tp_axis, f)),
+    ]
+    return tp_shard_rule(mesh, patterns)
+
+
+@dataclasses.dataclass
+class GSPMDTrainStep:
+    """Compiler-partitioned train step: parameters keep their annotated
+    shardings (TP / 2D TP x FSDP / anything expressible as NamedSharding),
+    and XLA inserts all collectives.
+
+    Use when no gradient comm hook is needed — for hooks (GossipGraD,
+    SlowMo) use :class:`ShardedTrainStep`.
+    """
+
+    loss_fn: Callable[[Any, Any], jax.Array]
+    optimizer: Any
+    mesh: Mesh
+    batch_spec: P = P()
+
+    def __post_init__(self) -> None:
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), params, updates
+            )
+            return params, opt_state, loss
+
+        self._jitted = jax.jit(step, donate_argnums=(0, 1))
+
+    def init_optimizer(self, params: Any) -> Any:
+        return jax.jit(self.optimizer.init)(params)
+
+    def __call__(self, params: Any, opt_state: Any, batch: Any):
+        batch = jax.device_put(
+            batch, NamedSharding(self.mesh, self.batch_spec)
+        )
+        return self._jitted(params, opt_state, batch)

@@ -25,7 +25,7 @@ buffers are created by ``init`` wherever the parameters live.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -64,28 +64,28 @@ def restore_checkpoint(
 
     path = os.path.abspath(path)
     ckptr = _checkpointer()
-    if shardings is None and like is None:
-        return ckptr.restore(path)
-
-    meta = ckptr.metadata(path).item_metadata.tree
-
-    def spec_for(leaf_meta, sh):
-        return ocp.ArrayRestoreArgs(sharding=sh) if sh is not None else ocp.RestoreArgs()
-
-    if shardings is not None and not isinstance(
-        shardings, (dict, list, tuple)
-    ):
-        one = shardings
-        restore_args = jax.tree_util.tree_map(
-            lambda m: spec_for(m, one), meta
-        )
-    elif shardings is not None:
-        restore_args = jax.tree_util.tree_map(spec_for, meta, shardings)
+    if shardings is None:
+        # `like` alone needs no restore_args (or metadata read) — it only
+        # post-validates/casts below
+        out = ckptr.restore(path)
     else:
-        restore_args = jax.tree_util.tree_map(
-            lambda m: ocp.RestoreArgs(), meta
-        )
-    out = ckptr.restore(path, restore_args=restore_args)
+        meta = ckptr.metadata(path).item_metadata.tree
+
+        def spec_for(leaf_meta, sh):
+            return (
+                ocp.ArrayRestoreArgs(sharding=sh)
+                if sh is not None
+                else ocp.RestoreArgs()
+            )
+
+        if not isinstance(shardings, (dict, list, tuple)):
+            one = shardings
+            restore_args = jax.tree_util.tree_map(
+                lambda m: spec_for(m, one), meta
+            )
+        else:
+            restore_args = jax.tree_util.tree_map(spec_for, meta, shardings)
+        out = ckptr.restore(path, restore_args=restore_args)
 
     if like is not None:
         like_struct = jax.tree_util.tree_structure(like)
