@@ -1,0 +1,153 @@
+"""Data pipeline + Trainer loop, incl. checkpoint/resume of a full training
+run (the reference's save/reload round-trip pattern at trainer scale)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.data import DataLoader, TokenDataset
+from torchdistx_tpu.nn import functional_call
+from torchdistx_tpu.optimizers import anyprecision_adamw
+from torchdistx_tpu.parallel import ShardedTrainStep, create_mesh
+from torchdistx_tpu.trainer import Trainer
+
+
+class TestTokenDataset:
+    def test_examples(self):
+        ds = TokenDataset(np.arange(100), seq_len=10)
+        assert len(ds) == 9
+        x, y = ds[0]
+        np.testing.assert_array_equal(x, np.arange(10))
+        np.testing.assert_array_equal(y, np.arange(1, 11))
+
+
+class TestDataLoader:
+    def test_batching_and_shapes(self):
+        ds = TokenDataset(np.arange(1000), seq_len=16)
+        dl = DataLoader(ds, batch_size=4, prefetch=0)
+        x, y = next(iter(dl))
+        assert x.shape == (4, 16) and y.shape == (4, 16)
+        assert isinstance(x, jax.Array)
+
+    def test_shuffle_deterministic(self):
+        ds = TokenDataset(np.arange(1000), seq_len=8)
+        a = DataLoader(ds, batch_size=4, shuffle=True, seed=7, prefetch=0)
+        b = DataLoader(ds, batch_size=4, shuffle=True, seed=7, prefetch=0)
+        xa, _ = next(iter(a))
+        xb, _ = next(iter(b))
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+    def test_prefetch_matches_sync(self):
+        ds = TokenDataset(np.arange(500), seq_len=8)
+        sync = [np.asarray(x) for x, _ in DataLoader(ds, 4, prefetch=0)]
+        pre = [np.asarray(x) for x, _ in DataLoader(ds, 4, prefetch=3)]
+        assert len(sync) == len(pre)
+        for a, b in zip(sync, pre):
+            np.testing.assert_array_equal(a, b)
+
+    def test_sharded_batches(self, mesh8):
+        ds = TokenDataset(np.arange(2000), seq_len=16)
+        sh = NamedSharding(mesh8, P("fsdp"))
+        dl = DataLoader(ds, batch_size=8, sharding=sh, prefetch=2)
+        x, _ = next(iter(dl))
+        assert x.sharding.is_equivalent_to(sh, x.ndim)
+
+    def test_resume_state(self):
+        ds = TokenDataset(np.arange(1000), seq_len=8)
+        dl = DataLoader(ds, batch_size=4, shuffle=True, seed=3, prefetch=0)
+        it = iter(dl)
+        next(it), next(it)
+        sd = dl.state_dict()
+        expected = next(it)
+
+        dl2 = DataLoader(ds, batch_size=4, shuffle=True, seed=3, prefetch=0)
+        dl2.load_state_dict(sd)
+        got = next(iter(dl2))
+        np.testing.assert_array_equal(np.asarray(expected[0]), np.asarray(got[0]))
+
+    def test_resume_state_exact_under_prefetch(self):
+        # regression: the prefetch worker must not advance resume state
+        # beyond what the consumer has received
+        ds = TokenDataset(np.arange(1000), seq_len=8)
+        dl = DataLoader(ds, batch_size=4, shuffle=True, seed=3, prefetch=3)
+        it = iter(dl)
+        next(it), next(it)
+        assert dl.state_dict()["pos"] == 2
+        expected = next(it)
+        it.close()  # abandon mid-epoch; worker must shut down
+
+        dl2 = DataLoader(ds, batch_size=4, shuffle=True, seed=3, prefetch=3)
+        dl2.load_state_dict({"epoch": 0, "pos": 2, "seed": 3})
+        got = next(iter(dl2))
+        np.testing.assert_array_equal(np.asarray(expected[0]), np.asarray(got[0]))
+
+    def test_prefetch_thread_shutdown_on_abandon(self):
+        import threading
+
+        before = threading.active_count()
+        ds = TokenDataset(np.arange(10000), seq_len=8)
+        for _ in range(5):
+            it = iter(DataLoader(ds, batch_size=4, prefetch=2))
+            next(it)
+            it.close()
+        import time
+
+        time.sleep(0.5)
+        assert threading.active_count() <= before + 1
+
+
+class TestTrainer:
+    def _setup(self, mesh):
+        tdx.manual_seed(0)
+        model = tdx.deferred_init(
+            lambda: nn.Sequential(nn.Embedding(64, 32), nn.Linear(32, 64))
+        )
+        tdx.materialize_module(model)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = functional_call(model, p, (x,))
+            return nn.functional.cross_entropy(logits, y)
+
+        step = ShardedTrainStep(
+            loss_fn, anyprecision_adamw(1e-2), mesh, shard_axis="fsdp"
+        )
+        params = step.shard_params(dict(model.named_parameters()))
+        return step, params
+
+    def test_fit_and_resume(self, mesh8, tmp_path):
+        step, params = self._setup(mesh8)
+        ds = TokenDataset(np.arange(10_000) % 64, seq_len=16)
+        logs = []
+        trainer = Trainer(
+            step,
+            params,
+            tokens_per_batch=8 * 16,
+            checkpoint_dir=str(tmp_path),
+            checkpoint_every=5,
+            log_every=5,
+            log_fn=logs.append,
+        )
+        dl = DataLoader(ds, batch_size=8, shuffle=True, seed=0)
+        out = trainer.fit(iter(dl), num_steps=10)
+        assert out["step"] == 10
+        assert logs and "tokens_per_sec" in logs[0]
+        first_loss, last_loss = logs[0]["loss"], logs[-1]["loss"]
+        assert last_loss < first_loss
+
+        # resume from the step-10 checkpoint and keep training
+        trainer2 = Trainer(step, params, log_every=5, log_fn=logs.append)
+        trainer2.restore(str(tmp_path / "step_10"))
+        assert trainer2.global_step == 10
+        # optimizer state classes rebuilt (NamedTuple, not dict)
+        assert type(trainer2.opt_state).__name__ == "AnyPrecisionAdamWState"
+        np.testing.assert_allclose(
+            np.asarray(trainer2.opt_state.exp_avg["1.weight"]),
+            np.asarray(trainer.opt_state.exp_avg["1.weight"]),
+        )
+        out2 = trainer2.fit(iter(dl), num_steps=15)
+        assert out2["step"] == 15

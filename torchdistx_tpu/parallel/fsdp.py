@@ -42,8 +42,35 @@ from .comm_hooks import DefaultState, Hook, HookContext, allreduce_hook
 __all__ = [
     "fsdp_partition_spec",
     "fsdp_shard_rule",
+    "optimizer_state_shardings",
     "ShardedTrainStep",
 ]
+
+
+def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
+    """Shardings for an optimizer state pytree: subtrees structurally equal
+    to ``params`` (optax's per-parameter slots) inherit the parameter
+    shardings; everything else (step counters, ...) is replicated.
+
+    Needed because jit's sharding propagation does NOT flow input shardings
+    into ``zeros_like``-style outputs that never read the input values —
+    without explicit out_shardings the whole optimizer state lands on one
+    device regardless of how the parameters are sharded.
+    """
+    pdef = jax.tree_util.tree_structure(params)
+    repl = NamedSharding(mesh, P())
+    psh = jax.tree_util.tree_map(
+        lambda p: p.sharding if isinstance(p, jax.Array) else repl, params
+    )
+
+    def is_param_like(t: Any) -> bool:
+        return jax.tree_util.tree_structure(t) == pdef
+
+    return jax.tree_util.tree_map(
+        lambda t: psh if is_param_like(t) else repl,
+        state_shape,
+        is_leaf=is_param_like,
+    )
 
 
 def fsdp_partition_spec(
@@ -185,8 +212,10 @@ class ShardedTrainStep:
         )(params)
 
     def init_optimizer(self, params: Any) -> Any:
-        """Optimizer state inherits parameter sharding via jit propagation."""
-        return jax.jit(self.optimizer.init)(params)
+        """Optimizer state placed to mirror parameter shardings (ZeRO)."""
+        state_shape = jax.eval_shape(self.optimizer.init, params)
+        shardings = optimizer_state_shardings(state_shape, params, self.mesh)
+        return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
 
     # -- the step ----------------------------------------------------------
 

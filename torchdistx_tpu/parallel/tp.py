@@ -25,7 +25,7 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .fsdp import fsdp_partition_spec
+from .fsdp import fsdp_partition_spec, optimizer_state_shardings
 
 __all__ = ["tp_shard_rule", "llama_tp_rule", "GSPMDTrainStep"]
 
@@ -113,10 +113,22 @@ class GSPMDTrainStep:
         self._jitted = jax.jit(step, donate_argnums=(0, 1))
 
     def init_optimizer(self, params: Any) -> Any:
-        return jax.jit(self.optimizer.init)(params)
+        state_shape = jax.eval_shape(self.optimizer.init, params)
+        shardings = optimizer_state_shardings(state_shape, params, self.mesh)
+        return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
 
     def __call__(self, params: Any, opt_state: Any, batch: Any):
-        batch = jax.device_put(
-            batch, NamedSharding(self.mesh, self.batch_spec)
-        )
+        target = NamedSharding(self.mesh, self.batch_spec)
+
+        mesh_devices = set(self.mesh.devices.flat)
+
+        def place(x: Any) -> Any:
+            # don't clobber batches the DataLoader already placed on this
+            # mesh (a device_put back to replicated would gather every
+            # step); only host arrays / off-mesh arrays get placed
+            if isinstance(x, jax.Array) and set(x.devices()) <= mesh_devices:
+                return x
+            return jax.device_put(x, target)
+
+        batch = jax.tree_util.tree_map(place, batch)
         return self._jitted(params, opt_state, batch)

@@ -1,0 +1,222 @@
+"""High-level trainer tying the pieces together: deferred init -> sharded
+materialize -> train loop with comm hooks, metrics, and checkpointing.
+
+The reference is explicitly *not* a trainer (SURVEY "What torchdistx is
+NOT") — it plugs into torch trainers.  This framework owns the host side,
+so it ships the loop: prefetching data, jitted steps, tokens/sec metrics,
+and periodic checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+
+from .utils.checkpoint import restore_checkpoint, save_checkpoint
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Drive a train step (ShardedTrainStep / GSPMDTrainStep / any callable
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``).
+
+    Args:
+      step: the step callable.
+      params / opt_state: initial state (``opt_state=None`` uses
+        ``step.init_optimizer(params)`` when available).
+      tokens_per_batch: if given, logs tokens/sec.
+      checkpoint_dir / checkpoint_every: periodic checkpointing.
+      log_every / log_fn: metric emission (default: one JSON line to
+        stdout).
+    """
+
+    def __init__(
+        self,
+        step: Callable[..., Any],
+        params: Any,
+        opt_state: Any = None,
+        *,
+        tokens_per_batch: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1000,
+        log_every: int = 50,
+        log_fn: Optional[Callable[[dict], None]] = None,
+    ) -> None:
+        self.step = step
+        self.params = params
+        if opt_state is None and hasattr(step, "init_optimizer"):
+            opt_state = step.init_optimizer(params)
+        self.opt_state = opt_state
+        self.tokens_per_batch = tokens_per_batch
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.log_every = log_every
+        self.log_fn = log_fn or (lambda m: print(json.dumps(m), flush=True))
+        self.global_step = 0
+        self._history: list[float] = []
+
+    # -- checkpoint --------------------------------------------------------
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or os.path.join(
+            self.checkpoint_dir or ".", f"step_{self.global_step}"
+        )
+        save_checkpoint(
+            path,
+            {
+                "params": self.params,
+                "opt_state": _to_tree(self.opt_state),
+                "global_step": self.global_step,
+            },
+        )
+        return path
+
+    def restore(self, path: str) -> None:
+        """Restore params/opt_state/step, re-placing every array onto the
+        sharding the current (template) state carries — so a TP/FSDP run
+        resumes into its mesh layout rather than replicated host arrays."""
+        out = restore_checkpoint(path)
+        self.params = _replace_like(self.params, out["params"])
+        # optimizer states are NamedTuples; orbax returns plain nests —
+        # rebuild onto the existing structure (by field name), then re-place
+        restored_opt = _from_tree(self.opt_state, out["opt_state"])
+        self.opt_state = _replace_like(self.opt_state, restored_opt)
+        self.global_step = int(out["global_step"])
+
+    # -- loop --------------------------------------------------------------
+
+    def fit(
+        self,
+        batches: Iterable[Any],
+        num_steps: Optional[int] = None,
+    ) -> dict:
+        """Run up to ``num_steps`` (or the iterable's length).  Returns final
+        metrics."""
+        t_window = time.time()
+        window_steps = 0
+        loss = None  # device array; only realized at log boundaries / return
+        it = iter(batches)
+        while True:
+            # check the budget BEFORE drawing a batch, so a bounded fit
+            # neither consumes nor transfers a batch it will not train on
+            if num_steps is not None and self.global_step >= num_steps:
+                break
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            self.params, self.opt_state, loss = self.step(
+                self.params, self.opt_state, batch
+            )
+            self.global_step += 1
+            window_steps += 1
+
+            if self.global_step % self.log_every == 0:
+                jax.block_until_ready(loss)
+                dt = time.time() - t_window
+                last_loss = float(loss)
+                metrics = {
+                    "step": self.global_step,
+                    "loss": round(last_loss, 6),
+                    "steps_per_sec": round(window_steps / dt, 3),
+                }
+                if self.tokens_per_batch:
+                    metrics["tokens_per_sec"] = round(
+                        self.tokens_per_batch * window_steps / dt, 1
+                    )
+                self._history.append(last_loss)
+                self.log_fn(metrics)
+                t_window = time.time()
+                window_steps = 0
+
+            if (
+                self.checkpoint_dir
+                and self.global_step % self.checkpoint_every == 0
+            ):
+                self.save()
+
+        return {
+            "step": self.global_step,
+            "loss": float(loss) if loss is not None else float("nan"),
+        }
+
+
+def _to_tree(x: Any) -> Any:
+    return x
+
+
+def _replace_like(template: Any, restored: Any) -> Any:
+    """Re-place restored arrays onto the shardings of the template tree."""
+
+    def place(tmpl, arr):
+        if isinstance(tmpl, jax.Array) and arr is not None:
+            return jax.device_put(arr, tmpl.sharding)
+        return arr
+
+    return jax.tree_util.tree_map(place, template, restored)
+
+
+def _from_tree(template: Any, restored: Any) -> Any:
+    """Rebuild ``template``'s pytree classes (optimizer NamedTuples) from a
+    plain nested-container restore.
+
+    orbax restores NamedTuples as dicts keyed by field name, so the rebuild
+    matches by NAME, never by leaf order (dict iteration is sorted, which
+    would silently permute same-shaped optimizer slots like exp_avg /
+    exp_avg_sq).
+    """
+    if template is None:
+        return None
+    if isinstance(template, tuple) and hasattr(template, "_fields"):
+        if isinstance(restored, dict):
+            missing = [f for f in template._fields if f not in restored]
+            # empty-container fields (e.g. a disabled Kahan buffer tuple)
+            # legitimately vanish in serialization
+            missing = [
+                f
+                for f in missing
+                if jax.tree_util.tree_leaves(getattr(template, f))
+            ]
+            if missing:
+                raise KeyError(
+                    f"restored optimizer state is missing fields {missing} "
+                    f"of {type(template).__name__}"
+                )
+            return type(template)(
+                **{
+                    f: _from_tree(getattr(template, f), restored.get(f))
+                    for f in template._fields
+                }
+            )
+        if len(restored) != len(template):
+            raise ValueError(
+                f"restored state has {len(restored)} entries, template "
+                f"{type(template).__name__} has {len(template)}"
+            )
+        return type(template)(
+            *(_from_tree(t, r) for t, r in zip(template, restored))
+        )
+    if isinstance(template, dict):
+        return {k: _from_tree(v, restored[k]) for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        if restored is None and len(template) == 0:
+            return template
+        restored_seq = (
+            list(restored.values())
+            if isinstance(restored, dict)
+            else list(restored)
+        )
+        if len(restored_seq) != len(template):
+            raise ValueError(
+                f"restored state has {len(restored_seq)} entries, template "
+                f"has {len(template)}"
+            )
+        return type(template)(
+            _from_tree(t, r) for t, r in zip(template, restored_seq)
+        )
+    return restored
