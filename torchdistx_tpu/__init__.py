@@ -14,6 +14,7 @@ Flagship features (reference README.md:15-18):
 __version__ = "0.1.0.dev0"
 
 from . import nn, ops
+from .generation import generate
 from .deferred_init import (
     can_materialize,
     deferred_init,
@@ -28,6 +29,7 @@ __all__ = [
     "__version__",
     "nn",
     "ops",
+    "generate",
     "fake_mode",
     "is_fake",
     "meta_like",

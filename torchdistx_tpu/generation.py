@@ -1,0 +1,106 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+Works with any model exposing ``init_cache(batch, max_seq)`` and
+``forward_cached(tokens, cache, cache_pos) -> (logits, cache)`` (Llama
+ships both).  The whole decode — prefill plus a ``lax.scan`` over new
+tokens — runs inside one jitted, static-shape computation, so there is one
+compile per (batch, prompt_len, max_new_tokens) signature and the per-token
+step is a single cached executable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .nn.module import functional_call
+
+__all__ = ["generate"]
+
+
+def generate(
+    model: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    params: Optional[dict] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
+
+    ``temperature == 0`` is greedy; otherwise samples with the given
+    temperature (``key`` required).  Returns (B, S + max_new_tokens).
+    """
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    params = params if params is not None else dict(model.named_parameters())
+    key = key if key is not None else jax.random.PRNGKey(0)
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    cfg = getattr(model, "cfg", None)
+    limit = getattr(cfg, "max_seq_len", None) or getattr(
+        cfg, "n_positions", None
+    )
+    if limit is not None and s + max_new_tokens > limit:
+        # RoPE/positional tables clamp silently past the end; fail loudly
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's maximum sequence length {limit}"
+        )
+
+    jitted = _build(model, b, s, int(max_new_tokens), float(temperature))
+    return jitted(params, prompt, key)
+
+
+def _build(model, b: int, s: int, max_new: int, temperature: float):
+    # cache lives ON the model so jitted executables (which close over the
+    # model) are collected with it rather than pinned by a module global
+    builders = model.__dict__.setdefault("_generate_cache", {})
+    cache_key = (b, s, max_new, temperature)
+    if cache_key in builders:
+        return builders[cache_key]
+
+    max_seq = s + max_new
+
+    def run(params, prompt, key):
+        def apply_cached(p, tokens, cache, pos):
+            return functional_call(
+                model, p, (tokens, cache, pos), method="forward_cached"
+            )
+
+        cache = model.init_cache(b, max_seq)
+        logits, cache = apply_cached(params, prompt, cache, 0)
+        last = logits[:, -1]
+
+        def sample(logits_1, k):
+            if temperature <= 0.0:
+                return jnp.argmax(logits_1, axis=-1).astype(prompt.dtype)
+            scaled = logits_1.astype(jnp.float32) / temperature
+            return jax.random.categorical(k, scaled, axis=-1).astype(
+                prompt.dtype
+            )
+
+        def step(carry, i):
+            cache, last_logits, k = carry
+            k, sub = jax.random.split(k)
+            tok = sample(last_logits, sub)
+            logits, cache = apply_cached(params, tok[:, None], cache, s + i)
+            return (cache, logits[:, -1], k), tok
+
+        (_, last_logits, key2), toks = jax.lax.scan(
+            step, (cache, last, key), jnp.arange(max_new - 1)
+        )
+        k_final, sub = jax.random.split(key2)
+        final_tok = sample(last_logits, sub)
+        out = jnp.concatenate(
+            [prompt, jnp.moveaxis(toks, 0, 1), final_tok[:, None]], axis=1
+        )
+        return out
+
+    jitted = jax.jit(run)
+    builders[cache_key] = jitted
+    return jitted

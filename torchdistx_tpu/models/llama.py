@@ -118,6 +118,42 @@ class LlamaAttention(nn.Module):
             out = multihead_attention(q, k, v, causal=True)
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))
 
+    def forward_cached(self, x, rope, cache, cache_pos):
+        """Incremental attention against a static-shape KV cache.
+
+        ``cache`` is ``(k, v)`` of shape (B, max_seq, Hkv, D); the new keys/
+        values are written at ``cache_pos`` (traced) and attention masks out
+        slots beyond ``cache_pos + s``.  Returns (out, new_cache).
+        """
+        import math as _math
+
+        b, s, _ = x.shape
+        cfg = self.cfg
+        q = self.wq(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = self.wk(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = self.wv(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, rope, cache_pos)
+        k = apply_rope(k, rope, cache_pos)
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_pos, 0, 0))
+        max_seq = ck.shape[1]
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
+        vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
+        scale = 1.0 / _math.sqrt(cfg.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+        # slot j visible to query i iff j <= cache_pos + i
+        visible = (
+            jnp.arange(max_seq)[None, :]
+            <= cache_pos + jnp.arange(s)[:, None]
+        )
+        logits = jnp.where(visible[None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        out = self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))
+        return out, (ck, cv)
+
 
 class LlamaMLP(nn.Module):
     def __init__(self, cfg: LlamaConfig):
@@ -141,6 +177,13 @@ class LlamaBlock(nn.Module):
     def forward(self, x, rope):
         x = x + self.attn(self.attn_norm(x), rope)
         return x + self.mlp(self.mlp_norm(x))
+
+    def forward_cached(self, x, rope, cache, cache_pos):
+        a, cache = self.attn.forward_cached(
+            self.attn_norm(x), rope, cache, cache_pos
+        )
+        x = x + a
+        return x + self.mlp(self.mlp_norm(x)), cache
 
 
 class Llama(nn.Module):
@@ -171,3 +214,32 @@ class Llama(nn.Module):
             x = block_fn(blk, x)
         x = self.norm(x)
         return self.lm_head(x)
+
+    # -- incremental decoding (KV cache) ----------------------------------
+
+    def init_cache(self, batch_size: int, max_seq: Optional[int] = None):
+        """Per-layer (k, v) caches of static shape (B, max_seq, Hkv, D)."""
+        cfg = self.cfg
+        max_seq = max_seq or cfg.max_seq_len
+        shape = (batch_size, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return [
+            (
+                jnp.zeros(shape, cfg.dtype),
+                jnp.zeros(shape, cfg.dtype),
+            )
+            for _ in range(cfg.n_layers)
+        ]
+
+    def forward_cached(self, tokens, cache, cache_pos):
+        """Run ``tokens`` (prefill chunk or single decode token) against the
+        cache starting at position ``cache_pos``.  Returns (logits,
+        new_cache)."""
+        cfg = self.cfg
+        x = self.tok_emb(tokens)
+        rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk.forward_cached(x, rope, c, cache_pos)
+            new_cache.append(c)
+        x = self.norm(x)
+        return self.lm_head(x), new_cache

@@ -195,8 +195,11 @@ def functional_call(
     params_and_buffers: dict[str, Any],
     args: tuple = (),
     kwargs: Optional[dict[str, Any]] = None,
+    *,
+    method: str = "forward",
 ) -> Any:
-    """Run ``module`` with ``params_and_buffers`` temporarily bound.
+    """Run ``module`` (or one of its methods) with ``params_and_buffers``
+    temporarily bound.
 
     The JAX-native analog of ``torch.func.functional_call``: inside
     ``jax.jit``, the bound values are tracers, making the whole forward a
@@ -208,7 +211,7 @@ def functional_call(
         saved[key] = _get_by_path(module, key)
         module._set_by_path(key, value)
     try:
-        return module(*args, **kwargs)
+        return getattr(module, method)(*args, **kwargs)
     finally:
         for key, value in saved.items():
             module._set_by_path(key, value)

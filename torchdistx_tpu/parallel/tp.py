@@ -123,11 +123,18 @@ class GSPMDTrainStep:
         mesh_devices = set(self.mesh.devices.flat)
 
         def place(x: Any) -> Any:
-            # don't clobber batches the DataLoader already placed on this
-            # mesh (a device_put back to replicated would gather every
-            # step); only host arrays / off-mesh arrays get placed
-            if isinstance(x, jax.Array) and set(x.devices()) <= mesh_devices:
-                return x
+            # keep batches the DataLoader already *distributed* on this mesh
+            # (re-placing them to batch_spec could gather every step), but a
+            # single-device array — e.g. a default device_put — must still
+            # be spread to batch_spec
+            if isinstance(x, jax.Array):
+                if x.sharding.is_equivalent_to(target, x.ndim):
+                    return x
+                if (
+                    len(x.sharding.device_set) > 1
+                    and x.sharding.device_set <= mesh_devices
+                ):
+                    return x
             return jax.device_put(x, target)
 
         batch = jax.tree_util.tree_map(place, batch)

@@ -70,7 +70,7 @@ class Trainer:
             path,
             {
                 "params": self.params,
-                "opt_state": _to_tree(self.opt_state),
+                "opt_state": self.opt_state,
                 "global_step": self.global_step,
             },
         )
@@ -144,10 +144,6 @@ class Trainer:
             "step": self.global_step,
             "loss": float(loss) if loss is not None else float("nan"),
         }
-
-
-def _to_tree(x: Any) -> Any:
-    return x
 
 
 def _replace_like(template: Any, restored: Any) -> Any:

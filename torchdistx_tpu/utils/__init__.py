@@ -1,3 +1,17 @@
+from .profiling import (
+    annotate,
+    device_memory_stats,
+    format_memory_stats,
+    trace,
+)
 from .rng import manual_seed, next_rng_key, rng_scope
 
-__all__ = ["manual_seed", "next_rng_key", "rng_scope"]
+__all__ = [
+    "manual_seed",
+    "next_rng_key",
+    "rng_scope",
+    "trace",
+    "annotate",
+    "device_memory_stats",
+    "format_memory_stats",
+]

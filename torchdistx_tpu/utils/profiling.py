@@ -1,0 +1,63 @@
+"""Observability: profiler traces and device memory stats.
+
+The reference has no tracing/metrics at all (SURVEY §5.1, §5.5); on TPU the
+canonical tools are XLA profiler traces (viewable in TensorBoard/XProf) and
+PJRT device memory counters.  These helpers wrap them with zero deps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "device_memory_stats", "format_memory_stats"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats(device: Optional[Any] = None) -> dict:
+    """Per-device memory counters (bytes_in_use, peak_bytes_in_use, ...).
+
+    Returns ``{device_str: stats_dict}``; devices without PJRT memory stats
+    (e.g. CPU) report an empty dict.
+    """
+    devices = [device] if device is not None else jax.devices()
+    out = {}
+    for d in devices:
+        try:
+            out[str(d)] = dict(d.memory_stats() or {})
+        except Exception:
+            out[str(d)] = {}
+    return out
+
+
+def format_memory_stats(stats: Optional[dict] = None) -> str:
+    stats = stats if stats is not None else device_memory_stats()
+    lines = []
+    for dev, s in stats.items():
+        if not s:
+            lines.append(f"{dev}: (no memory stats)")
+            continue
+        in_use = s.get("bytes_in_use", 0) / 1e9
+        peak = s.get("peak_bytes_in_use", 0) / 1e9
+        limit = s.get("bytes_limit", 0) / 1e9
+        lines.append(
+            f"{dev}: {in_use:.2f} GB in use (peak {peak:.2f} GB, "
+            f"limit {limit:.2f} GB)"
+        )
+    return "\n".join(lines)
