@@ -39,6 +39,7 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block
     sp_axis: Optional[str] = None  # ring attention over this mesh axis
+    use_flash: bool = False  # pallas flash-attention kernel (single chip)
 
     def __post_init__(self) -> None:
         if self.n_kv_heads is None:
@@ -114,6 +115,15 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, rope, pos_offset)
         if cfg.sp_axis is not None:
             out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
+        elif cfg.use_flash:
+            from ..ops.flash_attention import flash_attention
+
+            # largest power-of-two block (<=256) dividing the sequence, so
+            # any length works — matching the default path's flexibility
+            bq = 256
+            while bq > 1 and s % bq != 0:
+                bq //= 2
+            out = flash_attention(q, k, v, causal=True, block_q=bq)
         else:
             out = multihead_attention(q, k, v, causal=True)
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))

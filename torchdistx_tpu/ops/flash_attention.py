@@ -1,0 +1,171 @@
+"""Pallas flash attention for TPU.
+
+True flash schedule: the grid streams K/V blocks (innermost, sequential)
+against each Q block with an online-softmax accumulator in VMEM scratch —
+neither the (S x S) logits matrix nor the full K/V ever sit in VMEM, so
+context length is bounded by HBM, not VMEM, and HBM traffic stays O(S*D).
+Matmuls are MXU-shaped (block_q x d x block_k).
+
+GQA is handled in the BlockSpec index maps: K/V are laid out per KV head
+and each query head's programs map onto their group's KV blocks — no
+repeated K/V in HBM.
+
+The causal mask is end-aligned like ``multihead_attention`` (query i may
+see keys up to ``skv - sq + i``), so the two agree for every (Sq, Skv)
+combination, including cached decode where Sq < Skv.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+    diag_offset: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    logits = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # (block_q, block_k)
+    if causal:
+        rows = (
+            qi * block_q
+            + diag_offset
+            + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        )
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        logits = jnp.where(cols <= rows, logits, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.exp(logits - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
+
+    Requires ``Sq % block_q == 0`` and ``Skv % block_k == 0`` (both are
+    clamped to the sequence lengths first).  ``interpret`` defaults to True
+    off-TPU so the same code runs (slowly but exactly) on CPU platforms.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    n_rep = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q != 0:
+        raise ValueError(f"sequence {sq} not divisible by block_q {block_q}")
+    if skv % block_k != 0:
+        raise ValueError(
+            f"kv sequence {skv} not divisible by block_k {block_k}"
+        )
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n_k = skv // block_k
+
+    qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * hq, sq, d)
+    kh = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
+    vh = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
+
+    def kv_index(c, i, kk):
+        # combined q index c = batch * hq + h  ->  batch * hkv + h // n_rep
+        return (c // hq) * hkv + (c % hq) // n_rep, kk, 0
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel,
+            scale=scale_,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            n_k=n_k,
+            diag_offset=skv - sq,
+        ),
+        grid=(b * hq, sq // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
