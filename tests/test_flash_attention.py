@@ -48,10 +48,20 @@ def test_causal_cross_attention_end_aligned():
     )
 
 
-def test_block_divisibility_error():
-    q = jnp.zeros((1, 100, 4, 32))
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, q, q, block_q=64)
+def test_odd_lengths_auto_block():
+    # block sizes reduce to dividing values; odd lengths just work
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(1, 100, 4, 32), jnp.float32)
+    ref = multihead_attention(q, q, q, causal=True)
+    out = flash_attention(q, q, q, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_causal_sq_gt_skv_rejected():
+    q = jnp.zeros((1, 8, 2, 16))
+    k = jnp.zeros((1, 4, 2, 16))
+    with pytest.raises(ValueError, match="Sq"):
+        flash_attention(q, k, k, causal=True)
 
 
 def test_gqa_head_mismatch_error():

@@ -118,12 +118,8 @@ class LlamaAttention(nn.Module):
         elif cfg.use_flash:
             from ..ops.flash_attention import flash_attention
 
-            # largest power-of-two block (<=256) dividing the sequence, so
-            # any length works — matching the default path's flexibility
-            bq = 256
-            while bq > 1 and s % bq != 0:
-                bq //= 2
-            out = flash_attention(q, k, v, causal=True, block_q=bq)
+            # flash_attention reduces block sizes to dividing values itself
+            out = flash_attention(q, k, v, causal=True)
         else:
             out = multihead_attention(q, k, v, causal=True)
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))

@@ -56,35 +56,49 @@ def _kernel(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
-    logits = (
-        jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        * scale
-    )  # (block_q, block_k)
+    # block-level causal pruning: if this K block lies entirely above the
+    # diagonal for every row of the Q block, skip its MXU work outright
     if causal:
-        rows = (
-            qi * block_q
-            + diag_offset
-            + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        any_visible = ki * block_k <= (
+            qi * block_q + block_q - 1 + diag_offset
         )
-        cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 1
-        )
-        logits = jnp.where(cols <= rows, logits, _NEG_INF)
+    else:
+        any_visible = jnp.ones((), bool)
 
-    m_prev = m_ref[:]
-    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
-    p = jnp.exp(logits - m_new)
-    correction = jnp.exp(m_prev - m_new)
-    l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_ref[:] = m_new
+    @pl.when(any_visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        logits = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k)
+        if causal:
+            rows = (
+                qi * block_q
+                + diag_offset
+                + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+            )
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, logits.shape, 1
+            )
+            logits = jnp.where(cols <= rows, logits, _NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
 
     @pl.when(ki == n_k - 1)
     def _emit():
@@ -110,23 +124,28 @@ def flash_attention(
 ) -> jax.Array:
     """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
 
-    Requires ``Sq % block_q == 0`` and ``Skv % block_k == 0`` (both are
-    clamped to the sequence lengths first).  ``interpret`` defaults to True
-    off-TPU so the same code runs (slowly but exactly) on CPU platforms.
+    ``block_q``/``block_k`` are upper bounds: each is halved until it
+    divides its sequence length, so any length works.  ``interpret``
+    defaults to True off-TPU so the same code runs (slowly but exactly) on
+    CPU platforms.
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    if causal and sq > skv:
+        # every extra trailing query row would have an empty key set — the
+        # reference returns NaN there; fail loudly instead of diverging
+        raise ValueError(
+            f"causal attention requires Sq ({sq}) <= Skv ({skv})"
+        )
     n_rep = hq // hkv
     block_q = min(block_q, sq)
+    while block_q > 1 and sq % block_q != 0:
+        block_q //= 2
     block_k = min(block_k, skv)
-    if sq % block_q != 0:
-        raise ValueError(f"sequence {sq} not divisible by block_q {block_q}")
-    if skv % block_k != 0:
-        raise ValueError(
-            f"kv sequence {skv} not divisible by block_k {block_k}"
-        )
+    while block_k > 1 and skv % block_k != 0:
+        block_k //= 2
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"

@@ -3,6 +3,7 @@ from .comm_hooks import DefaultState, HookContext, allreduce_hook, noop_hook
 from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
 from .gossip_grad import GossipGraDState, Topology, gossip_grad_hook
 from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
+from .multihost import init_multihost, is_multihost, process_count, process_index
 from .pp import pipeline_apply, stack_pipeline_stages
 from .tp import GSPMDTrainStep, llama_tp_rule, tp_shard_rule
 
@@ -22,6 +23,10 @@ __all__ = [
     "hierarchical_mesh",
     "mesh_sharding",
     "replicated",
+    "init_multihost",
+    "is_multihost",
+    "process_index",
+    "process_count",
     "pipeline_apply",
     "stack_pipeline_stages",
     "GSPMDTrainStep",

@@ -1,0 +1,63 @@
+"""Multi-host (pod-scale) initialization.
+
+The reference relies on ``torchrun``/c10d rendezvous to stand up one
+process per accelerator (SURVEY §2.4).  JAX's multi-controller model is
+one process per *host*, each seeing its local chips, with XLA collectives
+spanning hosts over ICI/DCN once ``jax.distributed.initialize`` has run.
+This wrapper makes that the one-call analog of the reference's
+``init_process_group``; everything else in this framework (meshes,
+collectives, train steps, checkpointing) is already global-view and needs
+no changes to scale out.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_multihost", "is_multihost", "process_index", "process_count"]
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime.
+
+    On TPU pods every argument is auto-detected from the environment; on
+    other platforms pass the coordinator explicitly (the analog of the
+    reference ecosystem's MASTER_ADDR/RANK/WORLD_SIZE trio, which is also
+    honored here when set).
+    """
+    kwargs = {}
+    if coordinator_address is None:
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT", "8476")
+        if addr:
+            coordinator_address = f"{addr}:{port}"
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is None and os.environ.get("WORLD_SIZE"):
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is None and os.environ.get("RANK"):
+        process_id = int(os.environ["RANK"])
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
