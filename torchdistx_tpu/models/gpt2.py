@@ -8,11 +8,13 @@ MLP, weight-tied LM head.
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..nn import init
 from ..ops.attention import multihead_attention
 
 __all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
@@ -38,16 +40,28 @@ gpt2_configs = {
 }
 
 
+def _normal_init(std):
+    return lambda s, d: init.normal(s, std=std, dtype=d)
+
+
+def _zeros_init(s, d):
+    return init.zeros(s, d)
+
+
 class GPT2Block(nn.Module):
     def __init__(self, cfg: GPT2Config):
         super().__init__()
         d = cfg.dim
+        # GPT-2 scheme: N(0, 0.02) weights, zero biases, residual output
+        # projections scaled by 1/sqrt(2 * n_layers)
+        w = _normal_init(0.02)
+        w_res = _normal_init(0.02 / math.sqrt(2 * cfg.n_layers))
         self.ln1 = nn.LayerNorm(d, eps=cfg.norm_eps, dtype=cfg.dtype)
-        self.attn_qkv = nn.Linear(d, 3 * d, dtype=cfg.dtype)
-        self.attn_out = nn.Linear(d, d, dtype=cfg.dtype)
+        self.attn_qkv = nn.Linear(d, 3 * d, dtype=cfg.dtype, weight_init=w, bias_init=_zeros_init)
+        self.attn_out = nn.Linear(d, d, dtype=cfg.dtype, weight_init=w_res, bias_init=_zeros_init)
         self.ln2 = nn.LayerNorm(d, eps=cfg.norm_eps, dtype=cfg.dtype)
-        self.mlp_up = nn.Linear(d, 4 * d, dtype=cfg.dtype)
-        self.mlp_down = nn.Linear(4 * d, d, dtype=cfg.dtype)
+        self.mlp_up = nn.Linear(d, 4 * d, dtype=cfg.dtype, weight_init=w, bias_init=_zeros_init)
+        self.mlp_down = nn.Linear(4 * d, d, dtype=cfg.dtype, weight_init=w_res, bias_init=_zeros_init)
         self.n_heads = cfg.n_heads
 
     def forward(self, x):
@@ -65,8 +79,9 @@ class GPT2(nn.Module):
     def __init__(self, cfg: GPT2Config):
         super().__init__()
         self.cfg = cfg
-        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
-        self.pos_emb = nn.Embedding(cfg.n_positions, cfg.dim, dtype=cfg.dtype)
+        emb = _normal_init(0.02)
+        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, weight_init=emb)
+        self.pos_emb = nn.Embedding(cfg.n_positions, cfg.dim, dtype=cfg.dtype, weight_init=emb)
         self.blocks = nn.ModuleList([GPT2Block(cfg) for _ in range(cfg.n_layers)])
         self.ln_f = nn.LayerNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
 

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..nn import init as nn_init
 from ..ops.attention import multihead_attention, ring_attention
 
 __all__ = ["LlamaConfig", "Llama", "llama_configs"]
@@ -52,6 +53,11 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+
+def _hf_normal(shape, dtype):
+    """HF Llama init: N(0, initializer_range=0.02) for matmuls/embeddings."""
+    return nn_init.normal(shape, std=0.02, dtype=dtype)
 
 
 llama_configs = {
@@ -96,10 +102,13 @@ class LlamaAttention(nn.Module):
         super().__init__()
         d, hd = cfg.dim, cfg.head_dim
         self.cfg = cfg
-        self.wq = nn.Linear(d, cfg.n_heads * hd, bias=False, dtype=cfg.dtype)
-        self.wk = nn.Linear(d, cfg.n_kv_heads * hd, bias=False, dtype=cfg.dtype)
-        self.wv = nn.Linear(d, cfg.n_kv_heads * hd, bias=False, dtype=cfg.dtype)
-        self.wo = nn.Linear(cfg.n_heads * hd, d, bias=False, dtype=cfg.dtype)
+        lin = lambda i, o: nn.Linear(  # noqa: E731
+            i, o, bias=False, dtype=cfg.dtype, weight_init=_hf_normal
+        )
+        self.wq = lin(d, cfg.n_heads * hd)
+        self.wk = lin(d, cfg.n_kv_heads * hd)
+        self.wv = lin(d, cfg.n_kv_heads * hd)
+        self.wo = lin(cfg.n_heads * hd, d)
 
     def forward(self, x, rope, pos_offset=0):
         b, s, _ = x.shape
@@ -164,9 +173,12 @@ class LlamaAttention(nn.Module):
 class LlamaMLP(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
-        self.w_gate = nn.Linear(cfg.dim, cfg.ffn_dim, bias=False, dtype=cfg.dtype)
-        self.w_up = nn.Linear(cfg.dim, cfg.ffn_dim, bias=False, dtype=cfg.dtype)
-        self.w_down = nn.Linear(cfg.ffn_dim, cfg.dim, bias=False, dtype=cfg.dtype)
+        lin = lambda i, o: nn.Linear(  # noqa: E731
+            i, o, bias=False, dtype=cfg.dtype, weight_init=_hf_normal
+        )
+        self.w_gate = lin(cfg.dim, cfg.ffn_dim)
+        self.w_up = lin(cfg.dim, cfg.ffn_dim)
+        self.w_down = lin(cfg.ffn_dim, cfg.dim)
 
     def forward(self, x):
         return self.w_down(F.silu(self.w_gate(x)) * self.w_up(x))
@@ -196,10 +208,15 @@ class Llama(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
-        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.tok_emb = nn.Embedding(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, weight_init=_hf_normal
+        )
         self.blocks = nn.ModuleList([LlamaBlock(cfg) for _ in range(cfg.n_layers)])
         self.norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
-        self.lm_head = nn.Linear(cfg.dim, cfg.vocab_size, bias=False, dtype=cfg.dtype)
+        self.lm_head = nn.Linear(
+            cfg.dim, cfg.vocab_size, bias=False, dtype=cfg.dtype,
+            weight_init=_hf_normal,
+        )
 
     @classmethod
     def from_name(cls, name: str, **overrides) -> "Llama":

@@ -37,18 +37,24 @@ class Linear(Module):
         out_features: int,
         bias: bool = True,
         dtype=jnp.float32,
+        weight_init=None,
+        bias_init=None,
     ) -> None:
+        """``weight_init``/``bias_init``: optional ``fn(shape, dtype)``
+        overriding the torch-default kaiming/uniform initialization —
+        models pass their scheme here so parameters are drawn exactly once.
+        """
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
-        self.weight = Parameter(
-            init.kaiming_uniform((out_features, in_features), dtype=dtype)
-        )
+        if weight_init is None:
+            weight_init = lambda s, d: init.kaiming_uniform(s, dtype=d)  # noqa: E731
+        self.weight = Parameter(weight_init((out_features, in_features), dtype))
         if bias:
-            bound = init.linear_bias_bound(in_features)
-            self.bias = Parameter(
-                init.uniform((out_features,), -bound, bound, dtype=dtype)
-            )
+            if bias_init is None:
+                bound = init.linear_bias_bound(in_features)
+                bias_init = lambda s, d: init.uniform(s, -bound, bound, dtype=d)  # noqa: E731
+            self.bias = Parameter(bias_init((out_features,), dtype))
         else:
             self.register_parameter("bias", None)
 
@@ -57,13 +63,20 @@ class Linear(Module):
 
 
 class Embedding(Module):
-    def __init__(self, num_embeddings: int, features: int, dtype=jnp.float32):
+    def __init__(
+        self,
+        num_embeddings: int,
+        features: int,
+        dtype=jnp.float32,
+        weight_init=None,
+    ):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.features = features
-        self.weight = Parameter(
-            init.normal((num_embeddings, features), std=1.0, dtype=dtype)
-        )
+        if weight_init is None:
+            # torch.nn.Embedding default: N(0, 1)
+            weight_init = lambda s, d: init.normal(s, std=1.0, dtype=d)  # noqa: E731
+        self.weight = Parameter(weight_init((num_embeddings, features), dtype))
 
     def forward(self, ids):
         return F.embedding(ids, self.weight)

@@ -108,10 +108,124 @@ def _kernel(
 
 
 @functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_attention_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(
+        q,
+        k,
+        v,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(
+        q,
+        k,
+        v,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _attention_chunk(qc, k, v, row_offset, causal, scale):
+    """Reference attention for a Q chunk whose first global row is
+    ``row_offset`` (traced), against the full K/V.  f32 softmax, same math
+    as ``multihead_attention``."""
+    b, cq, hq, d = qc.shape
+    _, skv, hkv, _ = k.shape
+    if hq != hkv:
+        n_rep = hq // hkv
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qc, k).astype(jnp.float32) * s
+    if causal:
+        rows = row_offset + jnp.arange(cq)[:, None]
+        cols = jnp.arange(skv)[None, :]
+        logits = jnp.where(cols <= rows, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(qc.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    # Backward by CHUNKED recomputation: pallas_call has no autodiff rule,
+    # so each Q chunk's attention is recomputed with XLA and differentiated
+    # via jax.vjp, accumulating dK/dV across chunks under lax.scan.  Peak
+    # memory is O(chunk * Skv) — the flash working-set profile — instead of
+    # the O(Sq * Skv) a whole-matrix recompute would allocate.
+    q, k, v = res
+    b, sq, hq, d = q.shape
+    _, skv, _, _ = k.shape
+    chunk = min(block_q, sq)
+    while chunk > 1 and sq % chunk != 0:
+        chunk //= 2
+    n_chunks = sq // chunk
+    diag_offset = skv - sq
+
+    def body(carry, idx):
+        dk_acc, dv_acc = carry
+        qs = jax.lax.dynamic_slice_in_dim(q, idx * chunk, chunk, axis=1)
+        gs = jax.lax.dynamic_slice_in_dim(g, idx * chunk, chunk, axis=1)
+        row_offset = idx * chunk + diag_offset
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _attention_chunk(
+                q_, k_, v_, row_offset, causal, scale
+            ),
+            qs,
+            k,
+            v,
+        )
+        dq_c, dk_c, dv_c = vjp(gs)
+        return (dk_acc + dk_c, dv_acc + dv_c), dq_c
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        body,
+        (jnp.zeros_like(k), jnp.zeros_like(v)),
+        jnp.arange(n_chunks),
+    )
+    # (n_chunks, B, chunk, H, D) -> (B, Sq, H, D)
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(b, sq, hq, d)
+    return dq, dk, dv
+
+
+_flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Differentiable entry point: flash kernel forward, recomputed
+    reference backward (see ``_flash_bwd_rule``)."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_attention_vjp(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+
+
+@functools.partial(
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
-def flash_attention(
+def _flash_forward(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
