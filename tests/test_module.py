@@ -1,0 +1,85 @@
+"""nn.Module object-model edge cases (ADVICE round-1 items)."""
+
+import jax.numpy as jnp
+import pytest
+
+from torchdistx_tpu import nn
+
+
+class Tiny(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.weight = nn.Parameter(jnp.ones((2, 3)))
+        self.running = nn.Buffer(jnp.zeros((3,)))
+
+    def forward(self, x):
+        return x @ self.weight
+
+
+class TestSetattrOverRegistered:
+    def test_bare_array_updates_parameter_store(self):
+        m = Tiny()
+        new = jnp.full((2, 3), 7.0)
+        m.weight = new  # no Parameter() wrapper
+        # forward() and named_parameters must agree (no shadowing)
+        assert (dict(m.named_parameters())["weight"] == new).all()
+        assert (m.weight == new).all()
+        assert (m.state_dict()["weight"] == new).all()
+
+    def test_bare_array_updates_buffer_store(self):
+        m = Tiny()
+        new = jnp.full((3,), 2.0)
+        m.running = new
+        assert (dict(m.named_buffers())["running"] == new).all()
+
+    def test_non_array_assignment_still_plain_attribute(self):
+        m = Tiny()
+        m.note = "hello"
+        assert m.note == "hello"
+        assert "note" not in m._parameters
+
+
+class TestLoadStateDictValidation:
+    def test_shape_mismatch_raises(self):
+        m = Tiny()
+        bad = dict(m.state_dict())
+        bad["weight"] = jnp.ones((3, 2))
+        with pytest.raises(ValueError, match="shape mismatch.*weight"):
+            m.load_state_dict(bad)
+
+    def test_dtype_mismatch_casts(self):
+        # torch parity: load_state_dict copies via Tensor.copy_, which casts
+        m = Tiny()
+        sd = dict(m.state_dict())
+        sd["weight"] = jnp.full((2, 3), 1.5, jnp.bfloat16)
+        m.load_state_dict(sd)
+        assert m.weight.dtype == jnp.float32
+        assert (m.weight == 1.5).all()
+
+    def test_pre_init_assignment_messages(self):
+        class Broken(nn.Module):
+            def __init__(self):
+                self.w = nn.Parameter(jnp.ones(3))  # no super().__init__()
+
+        with pytest.raises(AttributeError, match="before Module.__init__"):
+            Broken()
+
+        class PlainAttrFirst(nn.Module):
+            def __init__(self):
+                self.dim = 4  # plain attribute before super() is fine
+                super().__init__()
+                self.w = nn.Parameter(jnp.ones(self.dim))
+
+        m = PlainAttrFirst()
+        assert m.dim == 4 and m.w.shape == (4,)
+
+    def test_matching_load_roundtrips(self):
+        m = Tiny()
+        sd = {k: v * 2 for k, v in m.state_dict().items()}
+        m.load_state_dict(sd)
+        assert (m.weight == 2.0).all()
+
+    def test_missing_key_raises_strict(self):
+        m = Tiny()
+        with pytest.raises(KeyError):
+            m.load_state_dict({"weight": jnp.ones((2, 3))})

@@ -119,6 +119,10 @@ class TestAnyPrecisionAdamW:
         err_plain = np.abs(plain - ref).mean()
         err_kahan = np.abs(kahan - ref).mean()
         assert err_kahan < err_plain
+        # the compensation accounts for the train step's second rounding
+        # (p + round(new_p - p)), so the tracked error stays under a bf16
+        # ulp at 1.0 (~3.9e-3) while the plain run loses every update
+        assert err_kahan < 2e-3
 
     def test_class_wrapper(self):
         params, loss_fn = _problem(seed=1)

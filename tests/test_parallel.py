@@ -1,8 +1,9 @@
 """Collectives + comm hooks + sharded train step.
 
 Test strategy mirrors the reference (SURVEY §4): emulate nodes as mesh
-sub-axes on one host, inject deterministic topologies
-(state.topology_cycle = itertools.cycle([...]), the analog of
+sub-axes on one host, inject deterministic virtual topologies
+(state.topologies_set = [perm] + state.topology_cycle = cycle([0]) +
+pinned state.iteration — see TestGossipGraD._pin, the analog of
 test_comm_hooks_fsdp.py:492-493), and check closed-form expected gradients
 computed from rank-valued inputs (:504-525)."""
 
@@ -121,11 +122,20 @@ class TestGossipGraD:
         )(x)
         return np.asarray(out).reshape(mesh.shape["node"], mesh.shape["local"])
 
+    @staticmethod
+    def _pin(state, topology, iteration=0):
+        """Inject a deterministic virtual topology (the analog of the
+        reference tests' state.topologies = itertools.cycle([...]),
+        test_comm_hooks_fsdp.py:492-493) and pin the step so
+        current_power = iteration % gossip_period."""
+        state.topologies_set = [tuple(topology)]
+        state.topology_cycle = itertools.cycle([0])
+        state.iteration = iteration
+
     def test_cube_closed_form(self, mesh2x4):
         # 2 nodes x 4 local; CUBE power 0: peer = node ^ 1
         state = GossipGraDState(2, topology=Topology.CUBE, seed=0)
-        state.topology_cycle = itertools.cycle([0])
-        state._current_power = 0
+        self._pin(state, [0, 1])
         out = self._run_hook(mesh2x4, state, [0.0, 1.0])
         # intra-node mean keeps node value; gossip: (0+1)/2 = 0.5 everywhere
         np.testing.assert_allclose(out, np.full((2, 4), 0.5))
@@ -133,12 +143,29 @@ class TestGossipGraD:
     def test_dissemination_closed_form(self):
         mesh = hierarchical_mesh(4)  # 4 nodes x 2 local
         state = GossipGraDState(4, topology=Topology.DISSEMINATION, seed=0)
-        state.topology_cycle = itertools.cycle([1])
-        state._current_power = 1
+        # gossip_period = 2, so iteration 1 -> power 1
+        self._pin(state, [0, 1, 2, 3], iteration=1)
+        assert state.current_power == 1
         out = self._run_hook(mesh, state, [0.0, 1.0, 2.0, 3.0])
         # node i receives from (i-2) % 4: out[i] = (i + (i-2)%4) / 2
         expected = np.array(
             [[(i + (i - 2) % 4) / 2.0] * 2 for i in range(4)]
+        )
+        np.testing.assert_allclose(out, expected)
+
+    def test_dissemination_permuted_topology(self):
+        # Non-identity virtual topology: peers are computed on positions in
+        # the permutation and mapped back (reference _get_send_recv_peers,
+        # gossip_grad.py:238-247 via cur_topology.index/indexing).
+        mesh = hierarchical_mesh(4)
+        state = GossipGraDState(4, topology=Topology.DISSEMINATION, seed=0)
+        topo = [2, 0, 3, 1]  # position of node i: pos = topo.index(i)
+        self._pin(state, topo, iteration=0)  # power 0, stride 1
+        out = self._run_hook(mesh, state, [0.0, 1.0, 2.0, 3.0])
+        # node i (at pos p) receives from topo[(p - 1) % 4]
+        pos = {n: p for p, n in enumerate(topo)}
+        expected = np.array(
+            [[(i + topo[(pos[i] - 1) % 4]) / 2.0] * 2 for i in range(4)]
         )
         np.testing.assert_allclose(out, expected)
 
@@ -149,25 +176,68 @@ class TestGossipGraD:
         devs = jax.devices()[:6]
         mesh = Mesh(np.array(devs).reshape(6, 1), ("node", "local"))
         state = GossipGraDState(6, topology=Topology.CUBE, seed=0)
-        state.topology_cycle = itertools.cycle([2])
-        state._current_power = 2
+        # gossip_period = ceil(log2(6)) = 3, so iteration 2 -> power 2
+        self._pin(state, [0, 1, 2, 3, 4, 5], iteration=2)
+        assert state.current_power == 2
         out = self._run_hook(mesh, state, [0.0, 1.0, 2.0, 3.0, 4.0, 5.0])
         expected = np.array(
             [[(0 + 4) / 2], [(1 + 5) / 2], [2.0], [3.0], [(4 + 0) / 2], [(5 + 1) / 2]]
         )
         np.testing.assert_allclose(out, expected)
 
-    def test_topology_rotation_schedule(self):
-        state = GossipGraDState(4, seed=0, gossip_period=2)
-        state.topology_cycle = itertools.cycle([0, 1])
-        powers = []
+    def test_default_schedule(self):
+        # Reference schedule (gossip_grad.py:236,378-380): power varies
+        # EVERY adjusted step as adjusted % gossip_period; the shuffled
+        # virtual topology rotates every gossip_period adjusted steps.
+        state = GossipGraDState(4, seed=0)
+        assert state.gossip_period == 2
+        powers, topo_idxs = [], []
         for _ in range(8):
-            powers.append(int(state.step_args()))
+            powers.append(state.current_power)
+            topo_idxs.append(state.current_topology_idx)
             state.advance()
-        # rotates every 2 steps
-        assert powers[0] == powers[1]
-        assert powers[2] == powers[3]
-        assert powers[0] != powers[2]
+        assert powers == [0, 1, 0, 1, 0, 1, 0, 1]
+        # one topology held for each full period, rotating each period
+        assert topo_idxs[0] == topo_idxs[1]
+        assert topo_idxs[2] == topo_idxs[3]
+        assert len(set(topo_idxs[::2])) > 1
+        # the pre-generated set contains num_nodes seeded permutations
+        assert len(state.topologies_set) == 4
+        assert all(sorted(t) == [0, 1, 2, 3] for t in state.topologies_set)
+        # step_args indexes the deduplicated branch table consistently
+        state2 = GossipGraDState(4, seed=0)
+        state2.iteration = 3  # period 1, power 1
+        specs, index = state2.branch_table()
+        assert int(state2.step_args()) == index[
+            (state2.current_topology_idx, state2.current_power)
+        ]
+        # dedup: unique branches never exceed the full (topo, power) grid
+        assert len(specs) <= len(state2.topologies_set) * state2.gossip_period
+
+    def test_branch_dedup_two_nodes(self):
+        # every 2-node permutation yields the same exchange: 1 unique branch
+        state = GossipGraDState(2, seed=0)
+        specs, _ = state.branch_table()
+        assert len(specs) == 1
+
+    def test_num_modules_adjustment(self):
+        # num_modules > 1: power/topology advance once per num_modules hook
+        # invocations (reference gossip_grad.py:373-379)
+        state = GossipGraDState(4, seed=0, num_modules=3)
+        powers = []
+        for _ in range(6):
+            powers.append(state.current_power)
+            state.advance()
+        assert powers == [0, 0, 0, 1, 1, 1]
+
+    def test_cube_odd_nodes_rejected(self):
+        # parity: gossip_grad.py:135-139
+        with pytest.raises(ValueError, match="uneven"):
+            GossipGraDState(3, topology=Topology.CUBE)
+
+    def test_default_topology_is_dissemination(self):
+        # parity: gossip_grad.py: 'topology or Topology.DISSEMINATION'
+        assert GossipGraDState(4).topology is Topology.DISSEMINATION
 
     def test_needs_two_nodes(self):
         with pytest.raises(ValueError):

@@ -51,6 +51,12 @@ class Module:
     # -- attribute plumbing ------------------------------------------------
 
     def __setattr__(self, name: str, value: Any) -> None:
+        d = self.__dict__
+        if isinstance(value, (Parameter, Buffer, Module)) and "_parameters" not in d:
+            raise AttributeError(
+                f"cannot assign {type(value).__name__} before "
+                f"Module.__init__() call (call super().__init__() first)"
+            )
         if isinstance(value, Parameter):
             self._parameters[name] = value.data
             self._buffers.pop(name, None)
@@ -63,6 +69,13 @@ class Module:
             self._modules[name] = value
             self._parameters.pop(name, None)
             self._buffers.pop(name, None)
+        elif name in d.get("_parameters", ()) and _is_array(value):
+            # bare-array assignment over a registered parameter updates the
+            # store — a plain instance attribute would shadow _parameters
+            # and desync forward() from named_parameters/state_dict
+            self._parameters[name] = value
+        elif name in d.get("_buffers", ()) and _is_array(value):
+            self._buffers[name] = value
         else:
             object.__setattr__(self, name, value)
 
@@ -145,6 +158,24 @@ class Module:
         for key, value in state.items():
             if key not in own:
                 continue
+            current = own[key]
+            if hasattr(current, "shape") and hasattr(value, "shape"):
+                if tuple(current.shape) != tuple(value.shape):
+                    raise ValueError(
+                        f"load_state_dict: shape mismatch for {key!r}: "
+                        f"checkpoint has {tuple(value.shape)}, module has "
+                        f"{tuple(current.shape)}"
+                    )
+                # dtype mismatches cast to the module's dtype — torch
+                # parity (load_state_dict copies via Tensor.copy_, which
+                # casts; only shapes are strict)
+                cur_dtype = getattr(current, "dtype", None)
+                if (
+                    cur_dtype is not None
+                    and getattr(value, "dtype", None) != cur_dtype
+                    and hasattr(value, "astype")
+                ):
+                    value = value.astype(cur_dtype)
             self._set_by_path(key, value)
 
     def _set_by_path(self, path: str, value: Any) -> None:

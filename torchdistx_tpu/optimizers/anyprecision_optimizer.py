@@ -117,9 +117,15 @@ def anyprecision_adamw(
                 pf = p.astype(jnp.float32)
                 buf = comp.astype(jnp.float32) + delta_of(p, m, v)
                 new_p = (pf + buf).astype(p.dtype)
-                applied = new_p.astype(jnp.float32) - pf
+                upd = (new_p - p).astype(p.dtype)
+                # The caller installs round(p + upd) — a second rounding the
+                # reference avoids by writing new_p in place (:169-178).
+                # Predict the actually-installed value so the compensation
+                # buffer absorbs BOTH roundings.
+                installed = (pf + upd.astype(jnp.float32)).astype(p.dtype)
+                applied = installed.astype(jnp.float32) - pf
                 return _Pair(
-                    (new_p - p).astype(p.dtype),
+                    upd,
                     (buf - applied).astype(compensation_buffer_dtype),
                 )
 

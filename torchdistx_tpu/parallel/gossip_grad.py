@@ -2,10 +2,15 @@
 onto XLA collectives.
 
 Reference implementation: torchdistx src/python/torchdistx/gossip_grad.py.
-Per-step pipeline there (gossip_grad.py:334-389): rotate virtual topology
-every ``gossip_period`` steps → intra-node allreduce → master-rank 2-peer
-gossip exchange via batched isend/irecv, ``grad = (grad + recv) * 0.5`` →
-broadcast from node master to the local group.
+Per-step pipeline there (gossip_grad.py:334-389): rotate the virtual
+topology every ``gossip_period`` adjusted steps → intra-node allreduce →
+master-rank 2-peer gossip exchange via batched isend/irecv,
+``grad = (grad + recv) * 0.5`` → broadcast from node master to the local
+group.  The exchange *power* varies every adjusted step:
+``power = (iter // num_modules) % gossip_period`` (gossip_grad.py:236), and
+the rotating topology is a seeded shuffled permutation of the nodes drawn
+from a pre-generated cycle of ``num_nodes`` shuffles (gossip_grad.py:380,
+``_generate_topologies`` gossip_grad.py:236-259).
 
 TPU-native translation:
   - "node" and "local" process groups -> the ``node``/``local`` mesh axes
@@ -17,13 +22,18 @@ TPU-native translation:
     This is mathematically identical to master-exchange-then-broadcast and
     strictly better on TPU: all local devices' links move shards of the
     gossip traffic in parallel instead of one master serializing it.
-  - topology rotation is host-side state; the current topology enters the
-    jitted step as a traced index selecting a ``lax.switch`` branch, each
-    branch closing over one static CollectivePermute.
+  - ``ppermute`` needs a *static* permutation, but the schedule is
+    host-side state; so every (topology-permutation, power) pair becomes a
+    static CollectivePermute branch and the per-step selection enters the
+    jitted step as a traced index into a ``lax.switch``.
 
-Peer selection parity (gossip_grad.py:210-247):
-  CUBE:          peer = node_rank XOR 2**power, INVALID (skip) if >= n
-  DISSEMINATION: send to (rank + 2**power) % n, recv from (rank - 2**power) % n
+Peer selection parity (gossip_grad.py:210-247): peers are computed in the
+*permuted* node space — ``node_rank = topology.index(node)`` — then mapped
+back through the permutation:
+  CUBE:          peer = topo[node_rank XOR 2**power], INVALID (skip) if the
+                 xor position falls outside the topology
+  DISSEMINATION: send to topo[(node_rank + 2**power) % n],
+                 recv from topo[(node_rank - 2**power) % n]
 """
 
 from __future__ import annotations
@@ -32,7 +42,7 @@ import enum
 import itertools
 import math
 import random
-from typing import Any, Iterable, Optional
+from typing import Any, Iterator, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -51,25 +61,31 @@ class Topology(enum.Enum):
     DISSEMINATION = "dissemination"
 
 
-def _peers(topology: Topology, power: int, num_nodes: int):
-    """Return (send_to, recv_from, valid) lists of length num_nodes."""
-    send, recv, valid = [], [], []
+def _peers(
+    topology: Topology, topo: Sequence[int], power: int, num_nodes: int
+):
+    """Return (send_to, recv_from, valid) lists of length num_nodes.
+
+    ``topo`` is the current virtual topology: a permutation of node ids.
+    Peer math runs on *positions* in the permutation and maps back to node
+    ids, mirroring ``_get_send_recv_peers`` (gossip_grad.py:210-247).
+    """
+    send = [INVALID_PEER] * num_nodes
+    recv = [INVALID_PEER] * num_nodes
+    valid = [False] * num_nodes
     stride = 2**power
+    position = {node: pos for pos, node in enumerate(topo)}
     for i in range(num_nodes):
+        pos = position[i]
         if topology is Topology.CUBE:
-            peer = i ^ stride
-            if peer >= num_nodes:
-                send.append(INVALID_PEER)
-                recv.append(INVALID_PEER)
-                valid.append(False)
-            else:
-                send.append(peer)
-                recv.append(peer)
-                valid.append(True)
+            peer_pos = pos ^ stride
+            if peer_pos < num_nodes:
+                send[i] = recv[i] = topo[peer_pos]
+                valid[i] = True
         else:
-            send.append((i + stride) % num_nodes)
-            recv.append((i - stride) % num_nodes)
-            valid.append(True)
+            send[i] = topo[(pos + stride) % num_nodes]
+            recv[i] = topo[(pos - stride) % num_nodes]
+            valid[i] = True
     return send, recv, valid
 
 
@@ -77,16 +93,21 @@ class GossipGraDState(DefaultState):
     """Hook state: topology schedule + iteration bookkeeping.
 
     Parity with the reference's ``GossipGraDState`` (gossip_grad.py:66-207):
-    seeded shuffled cycle over the ``log2(num_nodes)`` powers,
-    ``gossip_period = ceil(log2(num_nodes))``, and a ``num_modules``
-    correction for trainers that invoke the hook once per wrapped submodule
-    (gossip_grad.py:319-331,373-379; ours calls it once per step, so the
-    default is 1).
+    ``num_nodes`` seeded shuffled node permutations cycled every
+    ``gossip_period`` adjusted steps, per-step exchange power
+    ``(iteration // num_modules) % gossip_period``,
+    ``gossip_period = ceil(log2(num_nodes))``, default topology
+    DISSEMINATION (gossip_grad.py: ``topology or Topology.DISSEMINATION``),
+    and a ``num_modules`` correction for trainers that invoke the hook once
+    per wrapped submodule (gossip_grad.py:319-331,373-379; ours calls it
+    once per step, so the default is 1).
 
     Tests may inject a deterministic schedule by assigning
-    ``state.topology_cycle = itertools.cycle([power, ...])`` — the analog of
-    the reference tests' ``state.topologies = itertools.cycle([...])``
-    (test_comm_hooks_fsdp.py:492-493).
+    ``state.topologies_set = [perm, ...]`` +
+    ``state.topology_cycle = itertools.cycle(range(len(...)))`` — the
+    analog of the reference tests' ``state.topologies = itertools.cycle([...])``
+    (test_comm_hooks_fsdp.py:492-493) — and pinning ``state.iteration`` to
+    select the power.
     """
 
     def __init__(
@@ -95,54 +116,118 @@ class GossipGraDState(DefaultState):
         *,
         node_axis: str = "node",
         local_axis: Optional[str] = "local",
-        topology: Topology = Topology.CUBE,
-        seed: int = 0,
+        topology: Topology = Topology.DISSEMINATION,
+        seed: int = 2403,
         gossip_period: Optional[int] = None,
         num_modules: int = 1,
     ) -> None:
         super().__init__()
         if num_nodes < 2:
             raise ValueError("GossipGraD needs at least 2 nodes")
+        if num_nodes % 2 != 0 and topology is Topology.CUBE:
+            # parity: gossip_grad.py:135-139
+            raise ValueError(
+                "Current implementation doesn't support uneven number"
+                " of nodes for CUBE topology."
+            )
         self.num_nodes = num_nodes
         self.node_axis = node_axis
         self.local_axis = local_axis
         self.topology = topology
-        self.num_powers = max(1, math.ceil(math.log2(num_nodes)))
-        self.gossip_period = gossip_period or self.num_powers
+        self.gossip_period = gossip_period or max(
+            1, math.ceil(math.log2(num_nodes))
+        )
         self.num_modules = max(1, num_modules)
-        powers = list(range(self.num_powers))
-        random.Random(seed).shuffle(powers)
-        self.topology_cycle: Iterable[int] = itertools.cycle(powers)
-        self._current_power: Optional[int] = None
+        # Pre-generate num_nodes shuffled virtual topologies (reference
+        # _generate_topologies, gossip_grad.py:236-259 — node ids here
+        # instead of global ranks: the SPMD hook maps node -> mesh axis
+        # index, so no rank arithmetic is needed).
+        rng = random.Random(seed)
+        nodes = list(range(num_nodes))
+        topologies = []
+        for _ in range(num_nodes):
+            rng.shuffle(nodes)
+            topologies.append(tuple(nodes))
+        self.topologies_set: Sequence[Sequence[int]] = topologies
+        self.topology_cycle: Iterator[int] = itertools.cycle(
+            range(len(topologies))
+        )
+        self._current_topology_idx: Optional[int] = None
         self._rotation_idx = -1
+        self._spec_cache: Optional[tuple] = None
+
+    def branch_table(self):
+        """Deduplicated branch specs + (topology_idx, power) -> branch map.
+
+        Distinct (topology, power) pairs often produce identical peer
+        tables (e.g. every 2-node permutation yields the same exchange);
+        deduplicating keeps the ``lax.switch`` in the jitted step at the
+        number of *unique* CollectivePermutes instead of
+        ``len(topologies_set) * gossip_period``.  Recomputed lazily so
+        test-injected ``topologies_set`` take effect.
+        """
+        key = (
+            tuple(tuple(t) for t in self.topologies_set),
+            self.topology,
+            self.gossip_period,
+        )
+        if self._spec_cache is not None and self._spec_cache[0] == key:
+            return self._spec_cache[1], self._spec_cache[2]
+        specs: list = []
+        index: dict = {}
+        seen: dict = {}
+        for ti, topo in enumerate(self.topologies_set):
+            for power in range(self.gossip_period):
+                send, recv, valid = _peers(
+                    self.topology, topo, power, self.num_nodes
+                )
+                k = (tuple(send), tuple(recv))
+                if k not in seen:
+                    seen[k] = len(specs)
+                    specs.append((send, recv, valid))
+                index[(ti, power)] = seen[k]
+        self._spec_cache = (key, specs, index)
+        return specs, index
 
     @property
     def current_power(self) -> int:
-        """Current topology power; rotates every ``gossip_period`` adjusted
-        steps, drawing lazily from ``topology_cycle`` so injected
-        deterministic schedules take effect from the first step."""
+        """Exchange power for this step — varies *every* adjusted step
+        (reference gossip_grad.py:236)."""
+        return (self.iteration // self.num_modules) % self.gossip_period
+
+    @property
+    def current_topology_idx(self) -> int:
+        """Index of the active virtual topology; rotates every
+        ``gossip_period`` adjusted steps, drawing lazily from
+        ``topology_cycle`` so injected schedules take effect from the
+        first step (reference gossip_grad.py:378-380)."""
         adjusted = self.iteration // self.num_modules
         rotation = adjusted // self.gossip_period
-        if rotation != self._rotation_idx or self._current_power is None:
-            self._current_power = next(iter(self.topology_cycle))
+        if rotation != self._rotation_idx or self._current_topology_idx is None:
+            self._current_topology_idx = next(iter(self.topology_cycle))
             self._rotation_idx = rotation
-        return self._current_power
+        return self._current_topology_idx
+
+    @property
+    def current_topology(self) -> Sequence[int]:
+        return self.topologies_set[self.current_topology_idx]
 
     def step_args(self) -> Any:
-        return jnp.int32(self.current_power)
+        """Traced index into the deduplicated branch table shared with
+        :func:`gossip_grad_hook`."""
+        _, index = self.branch_table()
+        return jnp.int32(index[(self.current_topology_idx, self.current_power)])
 
 
 def gossip_grad_hook(state: GossipGraDState, grads: Any, ctx: HookContext) -> Any:
     """The hook.  Runs inside ``shard_map``; ``ctx.step`` carries the traced
-    topology index from ``state.step_args()``."""
+    (topology, power) branch index from ``state.step_args()``."""
     if state.local_axis is not None and state.local_axis in ctx.replica_axes:
         grads = collectives.all_mean(grads, state.local_axis)
 
     node_axis = state.node_axis
-    num_nodes = state.num_nodes
 
-    def make_branch(power: int):
-        send, recv, valid = _peers(state.topology, power, num_nodes)
+    def make_branch(send, recv, valid):
         valid_arr = jnp.asarray(valid)
 
         def branch(g):
@@ -154,7 +239,8 @@ def gossip_grad_hook(state: GossipGraDState, grads: Any, ctx: HookContext) -> An
 
         return branch
 
-    branches = [make_branch(p) for p in range(state.num_powers)]
+    specs, _ = state.branch_table()
+    branches = [make_branch(*spec) for spec in specs]
     if len(branches) == 1:
         return branches[0](grads)
     return lax.switch(ctx.step, branches, grads)
