@@ -186,3 +186,80 @@ def test_graph_gc_releases_replay_caches():
     assert g.num_released() == g.num_nodes()
     assert len(session.cache) == 0
     assert len(session.closures) == 0
+
+
+def test_double_materialize_is_stable_noop():
+    """Deliberate deviation from the reference, which raises on a second
+    materialize_module (reference deferred_init.py:110-113): here
+    materialization is identity-preserving, so a second call returns the
+    very same jax.Array objects (documented in materialize_module)."""
+    m = tdx.deferred_init(lambda: nn.Linear(4, 4))
+    tdx.materialize_module(m)
+    first = dict(m.named_parameters())
+    tdx.materialize_module(m)  # no error, no change
+    second = dict(m.named_parameters())
+    assert all(first[k] is second[k] for k in first)
+
+
+class TestRecordTimeSafety:
+    """Mutation guards + captured execution context (reference
+    deferred_init.cc:205-215,227-254,464-496,640-667)."""
+
+    def test_small_numpy_arg_copied_at_record(self):
+        # small arrays are deep-copied: post-record mutation cannot change
+        # materialization, which stays bit-identical to eager init
+        src = np.arange(6, dtype=np.float32)
+        fake = tdx.deferred_init(lambda: ops.asarray(src) * 2.0)
+        src[:] = -1.0  # mutate AFTER record
+        out = np.asarray(tdx.materialize_tensor(fake))
+        np.testing.assert_array_equal(out, np.arange(6, dtype=np.float32) * 2)
+
+    def test_large_numpy_arg_mutation_raises(self):
+        # large arrays are fingerprinted, not copied; mutation -> loud error
+        # (the version-counter analog)
+        src = np.ones((600, 600), dtype=np.float32)  # 1.44 MB > threshold
+        fake = tdx.deferred_init(lambda: ops.asarray(src) + 1.0)
+        src[123, 456] = 7.0
+        with pytest.raises(RuntimeError, match="mutated before"):
+            tdx.materialize_tensor(fake)
+
+    def test_large_numpy_arg_unmutated_ok(self):
+        src = np.full((600, 600), 3.0, dtype=np.float32)
+        fake = tdx.deferred_init(lambda: ops.asarray(src) + 1.0)
+        out = np.asarray(tdx.materialize_tensor(fake))
+        assert (out == 4.0).all()
+
+    def test_replay_reinstates_recorded_config(self):
+        # the captured-context analog of the reference's ThreadLocalState
+        # replay guard: the closure must execute under the jax config that
+        # was ambient at record time, not at materialize time
+        seen = []
+
+        def probing_zeros():
+            seen.append(jax.config.jax_default_matmul_precision)
+            return jnp.zeros((2,))
+
+        with jax.default_matmul_precision("float32"):
+            fake = tdx.deferred_init(lambda: ops.apply_op(probing_zeros))
+        assert seen[-1] == "float32"  # record-time trace
+        seen.clear()
+        assert jax.config.jax_default_matmul_precision != "float32"
+        tdx.materialize_tensor(fake)
+        assert seen[-1] == "float32"  # replay reinstated the context
+        # and ambient config is restored afterwards
+        assert jax.config.jax_default_matmul_precision != "float32"
+
+    def test_replay_matches_eager_under_x64_context(self):
+        def build():
+            return ops.arange(3, dtype=jnp.float64) * 1e-9 + 1.0
+
+        jax.config.update("jax_enable_x64", True)
+        try:
+            eager = np.asarray(build())  # real f64 values
+            fake = tdx.deferred_init(build)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+        # materialize OUTSIDE the x64 context: captured config must win
+        out = tdx.materialize_tensor(fake)
+        assert out.dtype == jnp.float64
+        np.testing.assert_array_equal(np.asarray(out), eager)

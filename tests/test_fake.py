@@ -103,3 +103,85 @@ def test_generic_jnp_surface_via_ops():
     r = ops.concatenate([jnp.ones((1, 2)), jnp.zeros((1, 2))], axis=0)
     assert isinstance(r, jax.Array)
     assert r.shape == (2, 2)
+
+
+class TestCatchAllInterception:
+    """The fake-mode escape hatch is closed: plain jnp cannot silently
+    allocate, fake args stay intercepted after the mode exits, comparisons
+    propagate, and terminal ops materialize (or raise the framework error).
+    Parity targets: reference fake.cc:546-548 (catch-all fallback),
+    deferred_init.cc:813-825 (aten::item force-materialization)."""
+
+    def test_plain_jnp_creation_is_intercepted(self):
+        with tdx.fake_mode():
+            z = jnp.zeros((4, 4))
+            assert tdx.is_fake(z)
+            a = jnp.array([1.0, 2.0])
+            assert tdx.is_fake(a)
+        # outside the mode, creation is real again
+        assert isinstance(jnp.zeros((2,)), jax.Array)
+
+    def test_jax_random_sampling_is_intercepted_keys_stay_real(self):
+        import jax.random as jrandom
+
+        with tdx.fake_mode():
+            key = jrandom.PRNGKey(0)
+            assert not tdx.is_fake(key)  # counter-RNG stream needs real keys
+            s = jrandom.normal(key, (8,))
+            assert tdx.is_fake(s)
+
+    def test_math_on_fakes_works_in_and_out_of_mode(self):
+        with tdx.fake_mode():
+            z = jnp.ones((3, 3))
+            assert tdx.is_fake(jnp.sin(z))
+        # leftover fake outside the mode: still intercepted (the record
+        # travels with the array, like the reference's tensor key set)
+        out = jnp.matmul(z, z)
+        assert tdx.is_fake(out) and out.shape == (3, 3)
+
+    def test_comparisons_propagate_not_silently_false(self):
+        with tdx.fake_mode():
+            f = jnp.ones((3,))
+            c = f == 2
+            assert tdx.is_fake(c)
+            assert c.dtype == jnp.bool_
+            with pytest.raises(RuntimeError, match="truth value"):
+                bool(c)
+            # non-array comparand falls back to identity semantics
+            assert (f == None) is False  # noqa: E711
+            assert (f != None) is True  # noqa: E711
+
+    def test_terminal_ops_materialize_deferred(self):
+        from torchdistx_tpu import nn
+
+        m = tdx.deferred_init(lambda: nn.Linear(4, 4))
+        w = m.weight
+        assert tdx.is_fake(w)
+        total = float(w.sum())  # derived value records + materializes
+        assert total == w.sum().item()
+        import numpy as np
+
+        arr = np.asarray(w)  # __array__ is terminal too
+        assert arr.shape == (4, 4)
+        assert w.tolist() == arr.tolist()
+
+    def test_terminal_ops_raise_for_plain_fakes(self):
+        with tdx.fake_mode():
+            g = jnp.ones(())
+        with pytest.raises(RuntimeError, match="plain[\\s\\S]*fake_mode"):
+            float(g)
+        with pytest.raises(RuntimeError, match="never be materialized"):
+            g.item()
+
+    def test_creation_inside_jit_is_not_faked(self):
+        # returning a FakeArray into a tracer would corrupt the trace; the
+        # trace guard lets jit-compiled creation run for real
+        with tdx.fake_mode():
+            out = jax.jit(lambda: jnp.zeros(3))()
+        assert isinstance(out, jax.Array)
+
+    def test_static_outputs_pass_through(self):
+        with tdx.fake_mode():
+            f = jnp.ones((2, 5))
+            assert jnp.shape(f) == (2, 5)
+            assert jnp.ndim(f) == 2

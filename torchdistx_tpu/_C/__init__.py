@@ -168,9 +168,14 @@ class NativeGraph:
         return _lib.tdx_node_state(self._h, node)
 
     def pin(self, node: int) -> None:
-        _lib.tdx_pin(self._h, node)
+        # _h can be None if cyclic GC finalized the graph first (the native
+        # side also tolerates NULL; both guards keep finalizer races benign)
+        if self._h:
+            _lib.tdx_pin(self._h, node)
 
     def unpin(self, node: int) -> bool:
+        if not self._h:
+            return False
         return bool(_lib.tdx_unpin(self._h, node))
 
     def num_nodes(self) -> int:

@@ -55,31 +55,129 @@ class NodeRef:
     out_idx: int
 
 
+# -- record-time safety ------------------------------------------------------
+# The reference validates immutable argument types and version-counters
+# external tensors so a mutation between record and replay cannot silently
+# change materialization (reference deferred_init.cc:227-254,464-496,640-667).
+# numpy args here are either deep-copied at record (small: replay is then
+# bit-identical to eager init regardless of later mutation) or fingerprinted
+# (large: replay re-checks the fingerprint and raises loudly on mismatch —
+# the version-counter analog, without doubling host RAM for big buffers).
+
+_COPY_THRESHOLD_BYTES = 1 << 20  # 1 MiB
+
+
+def _fingerprint(x) -> tuple:
+    import zlib
+
+    import numpy as np
+
+    if x.size == 0:
+        digest = 0
+    else:
+        # full crc32: deterministic detection of any content change.  Large
+        # recorded numpy args are rare (ctor constants are small; the HF
+        # interop path does not record raw weights), so the linear scan at
+        # record + replay is cheap in practice.
+        digest = zlib.crc32(np.ascontiguousarray(x).data)
+    return (tuple(x.shape), str(x.dtype), x.nbytes, digest)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardedArg:
+    """A large mutable (numpy) closure argument captured by reference with a
+    record-time fingerprint, re-verified at replay."""
+
+    value: Any
+    fingerprint: tuple
+
+    def resolve(self) -> Any:
+        if _fingerprint(self.value) != self.fingerprint:
+            raise RuntimeError(
+                "a numpy array captured at record time was mutated before "
+                "materialization; deferred replay would silently diverge "
+                "from eager init (the reference's version-counter check, "
+                "deferred_init.cc:640-667, raises here too). Re-record, or "
+                "avoid mutating arrays passed to ops inside deferred_init()."
+            )
+        return self.value
+
+
+def guard_mutable(x: Any) -> Any:
+    """Make a closure-captured leaf safe against external mutation."""
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        if x.nbytes <= _COPY_THRESHOLD_BYTES:
+            return np.array(x, copy=True)
+        return GuardedArg(x, _fingerprint(x))
+    return x
+
+
+# jax config entries reinstated at replay — the analog of the reference's
+# captured ThreadLocalState (deferred_init.cc:205-215,261-266): replay under
+# a different ambient precision/x64 context must still match eager init.
+_CAPTURED_CONFIG = (
+    "jax_default_matmul_precision",
+    "jax_enable_x64",
+    "jax_numpy_dtype_promotion",
+)
+
+
+def capture_context() -> dict[str, Any]:
+    out = {}
+    for k in _CAPTURED_CONFIG:
+        v = getattr(jax.config, k, None)
+        out[k] = v.value if hasattr(v, "value") else v
+    return out
+
+
 @dataclasses.dataclass
 class OpClosure:
-    """A recorded op: pure function + args with NodeRef placeholders."""
+    """A recorded op: pure function + args with NodeRef placeholders +
+    captured execution context."""
 
     fn: Callable[..., Any]
     args: tuple[Any, ...]
     kwargs: dict[str, Any]
     n_outputs: int  # flattened output count
     out_treedef: Any  # treedef to unflatten fn's output
+    tls: Optional[dict[str, Any]] = None  # captured jax config context
 
     def call(self, env: dict[tuple[int, int], Any]) -> list[Any]:
         def resolve(x: Any) -> Any:
             if isinstance(x, NodeRef):
                 return env[(x.node, x.out_idx)]
+            if isinstance(x, GuardedArg):
+                return x.resolve()
             return x
 
+        is_placeholder = lambda x: isinstance(x, (NodeRef, GuardedArg))  # noqa: E731
         args = jax.tree_util.tree_map(
-            resolve, self.args, is_leaf=lambda x: isinstance(x, NodeRef)
+            resolve, self.args, is_leaf=is_placeholder
         )
         kwargs = jax.tree_util.tree_map(
-            resolve, self.kwargs, is_leaf=lambda x: isinstance(x, NodeRef)
+            resolve, self.kwargs, is_leaf=is_placeholder
         )
-        out = self.fn(*args, **kwargs)
+        out = self._run(args, kwargs)
         leaves = jax.tree_util.tree_leaves(out)
         return leaves
+
+    def _run(self, args, kwargs):
+        if not self.tls:
+            return self.fn(*args, **kwargs)
+        saved = {}
+        try:
+            for k, v in self.tls.items():
+                cur = getattr(jax.config, k)
+                cur = cur.value if hasattr(cur, "value") else cur
+                if cur != v:
+                    saved[k] = cur
+                    jax.config.update(k, v)
+            return self.fn(*args, **kwargs)
+        finally:
+            for k, v in saved.items():
+                jax.config.update(k, v)
 
 
 class RecordingSession:
@@ -111,19 +209,22 @@ class RecordingSession:
         out_avals: Sequence[jax.ShapeDtypeStruct],
         out_treedef: Any,
         deps: Sequence[int],
+        tls: Optional[dict[str, Any]] = None,
     ) -> int:
         with self._lock:
             nid = self.graph.record_op(name, list(deps), len(out_avals))
             for i, aval in enumerate(out_avals):
-                self.graph.set_output_meta(
-                    nid, i, tuple(aval.shape), dtype_code(aval.dtype)
-                )
+                if hasattr(aval, "shape") and hasattr(aval, "dtype"):
+                    self.graph.set_output_meta(
+                        nid, i, tuple(aval.shape), dtype_code(aval.dtype)
+                    )
             self.closures[nid] = OpClosure(
                 fn=fn,
                 args=args,
                 kwargs=kwargs,
                 n_outputs=len(out_avals),
                 out_treedef=out_treedef,
+                tls=tls,
             )
             return nid
 

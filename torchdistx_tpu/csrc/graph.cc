@@ -19,6 +19,12 @@
 // Exposed as a flat C ABI consumed via ctypes (pybind11 is unavailable in
 // this environment; the ABI is deliberately simple enough that ctypes adds
 // no overhead worth native bindings).
+//
+// Every entry point tolerates a NULL handle: during Python cyclic GC the
+// graph owner's __del__ (which frees the handle and nulls it) can run
+// before a FakeArray finalizer that still calls pin/unpin through the
+// binding — the binding then passes None/NULL, which must be a no-op, not
+// a crash.
 
 #include <algorithm>
 #include <cstdint>
@@ -80,12 +86,15 @@ extern "C" {
 
 void* tdx_graph_new() { return new Graph(); }
 
-void tdx_graph_free(void* h) { delete static_cast<Graph*>(h); }
+void tdx_graph_free(void* h) {
+  if (h != nullptr) delete static_cast<Graph*>(h);
+}
 
 // Record one op.  deps may contain duplicates and -1 entries (non-graph
 // args); both are filtered here so Python can pass raw argument node ids.
 int64_t tdx_record_op(void* h, const char* name, const int64_t* deps,
                       int64_t ndeps, int32_t n_outputs) {
+  if (h == nullptr) return -1;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   int64_t id = static_cast<int64_t>(g.nodes.size());
@@ -119,6 +128,7 @@ int64_t tdx_record_op(void* h, const char* name, const int64_t* deps,
 void tdx_set_output_meta(void* h, int64_t node, int32_t out_idx,
                          const int64_t* dims, int32_t rank,
                          int32_t dtype_code) {
+  if (h == nullptr) return;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return;
@@ -134,6 +144,7 @@ void tdx_set_output_meta(void* h, int64_t node, int32_t out_idx,
 int32_t tdx_get_output_meta(void* h, int64_t node, int32_t out_idx,
                             int64_t* out_dims, int32_t max_rank,
                             int32_t* out_dtype_code) {
+  if (h == nullptr) return -1;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return -1;
@@ -156,6 +167,7 @@ int32_t tdx_get_output_meta(void* h, int64_t node, int32_t out_idx,
 // (unknown node, or a required dependency was already released).
 int64_t tdx_collect_schedule(void* h, int64_t target, int64_t* out,
                              int64_t cap) {
+  if (h == nullptr) return -2;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, target)) return -2;
@@ -189,6 +201,7 @@ int64_t tdx_collect_schedule(void* h, int64_t target, int64_t* out,
 // mutating anything so the caller can retry with a bigger buffer.
 int64_t tdx_mark_materialized(void* h, int64_t node, int64_t* out_releasable,
                               int64_t cap) {
+  if (h == nullptr) return 0;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return 0;
@@ -229,6 +242,7 @@ int64_t tdx_mark_materialized(void* h, int64_t node, int64_t* out_releasable,
 }
 
 int32_t tdx_node_state(void* h, int64_t node) {
+  if (h == nullptr) return -1;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return -1;
@@ -238,6 +252,7 @@ int32_t tdx_node_state(void* h, int64_t node) {
 // Pin/unpin: a live Python FakeArray handle pins its producer node so GC
 // never drops an output the user can still materialize.
 void tdx_pin(void* h, int64_t node) {
+  if (h == nullptr) return;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (valid_id(g, node)) g.nodes[static_cast<size_t>(node)].pins += 1;
@@ -246,6 +261,7 @@ void tdx_pin(void* h, int64_t node) {
 // Returns 1 if the unpin made the node releasable (Python should drop its
 // cached replay output), else 0.
 int32_t tdx_unpin(void* h, int64_t node) {
+  if (h == nullptr) return 0;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return 0;
@@ -260,18 +276,21 @@ int32_t tdx_unpin(void* h, int64_t node) {
 }
 
 int64_t tdx_num_nodes(void* h) {
+  if (h == nullptr) return 0;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   return static_cast<int64_t>(g.nodes.size());
 }
 
 int64_t tdx_num_materialized(void* h) {
+  if (h == nullptr) return 0;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   return g.materialized_count;
 }
 
 int64_t tdx_num_released(void* h) {
+  if (h == nullptr) return 0;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   return g.released_count;
@@ -279,6 +298,7 @@ int64_t tdx_num_released(void* h) {
 
 // Dependency introspection, used by Python for debugging / graph dumps.
 int64_t tdx_get_deps(void* h, int64_t node, int64_t* out, int64_t cap) {
+  if (h == nullptr) return -2;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return -2;
@@ -289,6 +309,7 @@ int64_t tdx_get_deps(void* h, int64_t node, int64_t* out, int64_t cap) {
 }
 
 int64_t tdx_get_dependents(void* h, int64_t node, int64_t* out, int64_t cap) {
+  if (h == nullptr) return -2;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return -2;
@@ -299,6 +320,7 @@ int64_t tdx_get_dependents(void* h, int64_t node, int64_t* out, int64_t cap) {
 }
 
 int64_t tdx_get_name(void* h, int64_t node, char* out, int64_t cap) {
+  if (h == nullptr) return -1;
   Graph& g = *static_cast<Graph*>(h);
   std::lock_guard<std::mutex> lock(g.mu);
   if (!valid_id(g, node)) return -1;

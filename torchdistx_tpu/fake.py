@@ -87,13 +87,21 @@ def fake_mode(*, fake_tpu: bool = False):
     Re-entrant, like the reference's TLS mode counter (fake.cc:595-623).
     With ``fake_tpu=True``, creation ops default to claiming a TPU device
     even when no TPU is attached.
+
+    While the mode is active the public ``jnp`` / ``jax.random`` surfaces
+    are intercepted (ops._intercept) so plain ``jnp.zeros(...)`` cannot
+    silently allocate — the analog of the reference's catch-all dispatcher
+    fallback (fake.cc:546-548).
     """
+    from .ops import _intercept
+
     _tls.fake_level += 1
     prev_fake_tpu = _tls.fake_tpu
     prev_default = _tls.default_device
     if fake_tpu:
         _tls.fake_tpu = True
         _tls.default_device = FakeDevice("tpu", 0)
+    _intercept.ensure_installed()
     try:
         yield
     finally:
@@ -103,10 +111,13 @@ def fake_mode(*, fake_tpu: bool = False):
 
 
 def _enter_deferred(session: Any) -> None:
+    from .ops import _intercept
+
     if _tls.session is not None:
         raise RuntimeError("deferred_init contexts cannot be nested")
     _tls.session = session
     _tls.fake_level += 1
+    _intercept.ensure_installed()
 
 
 def _leave_deferred() -> None:
@@ -210,6 +221,52 @@ class FakeArray:
     def __format__(self, spec: str) -> str:
         return repr(self)
 
+    # -- terminal ops ------------------------------------------------------
+    # The reference force-materializes the arguments of terminal ops
+    # (aten::item) in deferred context (deferred_init.cc:813-825); a fake
+    # tensor with no record cannot produce a value and errors with a
+    # storage message instead of an opaque TypeError.
+
+    def _force_materialize(self, what: str):
+        if self.is_deferred:
+            from .deferred_init import materialize_tensor
+
+            return materialize_tensor(self)
+        raise RuntimeError(
+            f"{what} needs array data, but this fake array has no storage "
+            "and no deferred-init record (it was created under plain "
+            "fake_mode()), so it can never be materialized; construct it "
+            "under deferred_init() (terminal ops then materialize it "
+            "automatically) or use real arrays"
+        )
+
+    def item(self):
+        return self._force_materialize("item()").item()
+
+    def tolist(self):
+        import numpy as np
+
+        return np.asarray(self._force_materialize("tolist()")).tolist()
+
+    def __float__(self) -> float:
+        return float(self._force_materialize("float()"))
+
+    def __int__(self) -> int:
+        return int(self._force_materialize("int()"))
+
+    def __complex__(self) -> complex:
+        return complex(self._force_materialize("complex()"))
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+
+        return np.asarray(self._force_materialize("np.asarray()"), dtype)
+
+    def __iter__(self):
+        if not self._aval.shape:
+            raise TypeError("iteration over a 0-d fake array")
+        return (self[i] for i in range(self._aval.shape[0]))
+
     # -- ops (recorded / shape-propagated) --------------------------------
 
     def _op(self, fn, *args, **kwargs):
@@ -252,6 +309,44 @@ class FakeArray:
 
     def __rmatmul__(self, o):
         return self._op(jnp.matmul, o, self)
+
+    # -- comparisons -------------------------------------------------------
+    # The reference dispatches aten::eq etc. through the Fake handler like
+    # any other op; without these dunders Python would fall back to
+    # identity and `fake == 2` would silently return False — the silent
+    # wrong-branch failure mode.  Comparisons propagate/record like every
+    # other op; branching on the result raises loudly via __bool__.
+
+    def _cmp(self, o, fn):
+        import numpy as np
+
+        if isinstance(
+            o, (int, float, bool, complex, jax.Array, FakeArray, np.ndarray)
+        ) or hasattr(o, "__jax_array__"):
+            return self._op(fn, self, o)
+        return NotImplemented
+
+    def __eq__(self, o):
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        return self._cmp(o, jnp.not_equal)
+
+    def __lt__(self, o):
+        return self._cmp(o, jnp.less)
+
+    def __le__(self, o):
+        return self._cmp(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._cmp(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._cmp(o, jnp.greater_equal)
+
+    # defining __eq__ suppresses the default hash; fake arrays hash by
+    # identity like torch tensors
+    __hash__ = object.__hash__
 
     def __getitem__(self, idx):
         return self._op(lambda x: x[idx], self)

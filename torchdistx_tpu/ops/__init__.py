@@ -23,7 +23,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from .._graph import NodeRef
+from .._graph import NodeRef, capture_context, guard_mutable
 from ..fake import (
     FakeArray,
     FakeDevice,
@@ -65,6 +65,10 @@ def apply_op(
     **kwargs: Any,
 ):
     """Apply ``fn`` under the fake/deferred interposition rules above."""
+    # If fn is an interposed jnp/jax.random wrapper (ops._intercept), use
+    # the original: the closure must execute the real op during eval_shape
+    # and replay, not re-enter the interception layer.
+    fn = getattr(fn, "__wrapped_original__", fn)
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=_is_fake_leaf
     )
@@ -117,6 +121,13 @@ def apply_op(
             "fake arrays from different deferred_init sessions cannot be "
             "mixed in one op"
         )
+    if session is None and len(arg_sessions) == 1:
+        # Ops on deferred fakes outside the recording context still record
+        # into their session: the record travels with the array the way the
+        # reference's per-tensor dispatch_data does (fake.cc:118-121), so a
+        # value derived from a materializable array stays materializable
+        # instead of dead-ending as a plain fake.
+        session = next(iter(arg_sessions))
 
     name = op_name or getattr(fn, "__name__", None) or "op"
 
@@ -135,21 +146,40 @@ def apply_op(
             )
 
         closure_dyn = [
-            NodeRef(x._node, x._out_idx) if isinstance(x, FakeArray) else x
+            NodeRef(x._node, x._out_idx)
+            if isinstance(x, FakeArray)
+            # numpy args are mutable: copy small / fingerprint large so a
+            # post-record mutation cannot silently change materialization
+            # (reference deferred_init.cc:227-254,464-496)
+            else guard_mutable(x)
             for x in (leaves[i] for i in dyn_idx)
         ]
         deps = [f._node for f in fakes]
         nid = session.record(
-            name, call_with, (closure_dyn,), {}, out_leaves, out_tree, deps
+            name,
+            call_with,
+            (closure_dyn,),
+            {},
+            out_leaves,
+            out_tree,
+            deps,
+            tls=capture_context(),
         )
         results = [
             FakeArray(aval, device, session, nid, i)
+            if isinstance(aval, jax.ShapeDtypeStruct)
+            else aval  # static outputs (shapes, dtypes) pass through
             for i, aval in enumerate(out_leaves)
         ]
     else:
         # Plain fake mode (or ops on leftover fakes outside any mode):
         # results are fake and unmaterializable.
-        results = [FakeArray(aval, device) for aval in out_leaves]
+        results = [
+            FakeArray(aval, device)
+            if isinstance(aval, jax.ShapeDtypeStruct)
+            else aval
+            for aval in out_leaves
+        ]
 
     return jax.tree_util.tree_unflatten(out_tree, results)
 
@@ -215,8 +245,11 @@ def eye(n, m=None, dtype=jnp.float32, device=None):
 
 
 def asarray(x, dtype=None, device=None):
+    # x rides as a real argument (not a lambda capture) so mutable numpy
+    # inputs pass through the record-time guard in apply_op
     return apply_op(
-        lambda: jnp.asarray(x, dtype=dtype),
+        lambda v: jnp.asarray(v, dtype=dtype),
+        x,
         op_name="asarray",
         claim_device=_as_device(device),
     )

@@ -1,0 +1,243 @@
+"""Context-scoped interception of the public ``jax.numpy`` / ``jax.random``
+surface — closing the fake-mode escape hatch.
+
+The reference's Fake key is a dispatcher *catch-all* (reference
+src/cc/torchdistx/fake.cc:546-548): inside ``fake_mode()`` nothing can
+allocate, and ops on fake tensors are intercepted even *outside* the mode
+because the Fake key lives in the tensor's own dispatch key set.  JAX has
+no dispatcher to hook, so the public ``jnp`` namespace is patched (once,
+on first fake/deferred entry, then left installed): a call whose arguments
+contain a :class:`FakeArray` — in or out of the mode, mirroring the
+key-set behavior — or a *creation* call made by a thread inside fake mode,
+routes through :func:`ops.apply_op` (shape propagation / recording);
+everything else passes straight through to the original with only a cheap
+argument scan.
+
+Scope and limitations (documented divergence from a true dispatcher hook):
+  - only attribute lookups through the module namespace are intercepted;
+    references captured *before* the patch (``from jax.numpy import zeros``)
+    and non-jnp entry points (``jax.nn.relu``) escape it — a fake argument
+    there surfaces JAX's invalid-type error whose repr shows ``fake=True``;
+  - ``jax.random`` key plumbing (``PRNGKey``/``key``/``split``/``fold_in``)
+    is deliberately NOT intercepted — keys stay real so the counter-based
+    RNG stream (utils/rng.py) keeps deferred/eager init bit-identical;
+  - creation calls inside an active jax trace (jit/grad) are not faked:
+    returning a FakeArray into a tracer would corrupt the trace.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import types
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ensure_installed", "uninstall"]
+
+# jnp functions that allocate from nothing (the reference's "factory ops",
+# fake.cc:462-464: ops with no tensor args get faked under the mode).
+_JNP_CREATION = {
+    "array",
+    "asarray",
+    "ascontiguousarray",
+    "zeros",
+    "ones",
+    "empty",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "empty_like",
+    "full_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "geomspace",
+    "eye",
+    "identity",
+    "tri",
+    "frombuffer",
+    "fromfunction",
+    "fromiter",
+}
+
+# Metadata-only functions are never interposed: they read shape/dtype
+# attributes, which FakeArray provides, and routing them through eval_shape
+# would abstract their static int/dtype outputs into avals.
+_METADATA_PASSTHROUGH = {
+    "shape",
+    "ndim",
+    "size",
+    "result_type",
+    "promote_types",
+    "issubdtype",
+    "isdtype",
+    "iscomplexobj",
+    "isrealobj",
+    "isscalar",
+    "can_cast",
+    "save",
+    "savez",
+    "load",
+    "dtype",
+    "broadcast_shapes",
+    "get_printoptions",
+    "set_printoptions",
+    "printoptions",
+}
+
+# jax.random samplers (factory ops keyed by a real PRNG key).
+_RANDOM_CREATION = {
+    "normal",
+    "uniform",
+    "truncated_normal",
+    "bernoulli",
+    "randint",
+    "gumbel",
+    "exponential",
+    "laplace",
+    "logistic",
+    "cauchy",
+    "gamma",
+    "beta",
+    "chisquare",
+    "dirichlet",
+    "poisson",
+    "rademacher",
+    "maxwell",
+    "pareto",
+    "t",
+    "ball",
+    "orthogonal",
+    "loggamma",
+    "categorical",
+    "choice",
+    "permutation",
+    "multivariate_normal",
+    "double_sided_maxwell",
+    "weibull_min",
+}
+
+
+def _has_fake(values) -> bool:
+    from ..fake import FakeArray
+
+    for v in values:
+        if isinstance(v, FakeArray):
+            return True
+        if isinstance(v, (list, tuple)):
+            for w in v:
+                if isinstance(w, FakeArray):
+                    return True
+    return False
+
+
+def _trace_clean() -> bool:
+    try:
+        from jax._src import core as _core
+
+        return _core.trace_state_clean()
+    except Exception:
+        return True
+
+
+def _make_wrapper(name: str, orig: Callable[..., Any], creation: bool):
+    from ..fake import in_fake_mode
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        from . import apply_op
+
+        if _has_fake(args) or _has_fake(kwargs.values()):
+            return apply_op(orig, *args, op_name=name, **kwargs)
+        if creation and in_fake_mode() and _trace_clean():
+            return apply_op(orig, *args, op_name=name, **kwargs)
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped_original__ = orig  # uninstall marker
+    return wrapper
+
+
+class _InterposedUfunc:
+    """Callable proxy for ``jnp.ufunc`` objects (``add``, ``maximum``, ...):
+    interposes ``__call__`` while delegating every other attribute —
+    ``.at``, ``.reduce``, ``.accumulate``, ``.outer`` — to the original, so
+    the ufunc method surface survives the patch."""
+
+    def __init__(self, call_wrapper: Callable[..., Any], orig: Any) -> None:
+        self.__dict__["_call_wrapper"] = call_wrapper
+        self.__dict__["__wrapped_original__"] = orig
+
+    def __call__(self, *args, **kwargs):
+        return self._call_wrapper(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["__wrapped_original__"], name)
+
+    def __repr__(self) -> str:
+        return repr(self.__dict__["__wrapped_original__"])
+
+
+def _is_ufunc_like(obj: Any) -> bool:
+    return hasattr(obj, "at") and hasattr(obj, "reduce") and callable(obj)
+
+
+def _wrappable(obj: Any) -> bool:
+    if isinstance(obj, (type, types.ModuleType)):
+        return False
+    if hasattr(obj, "__wrapped_original__"):
+        return False  # already patched
+    return callable(obj)
+
+
+class _Patcher:
+    """Installs the wrappers once and leaves them in place: a FakeArray can
+    outlive the context that created it, and parity requires ops on it to
+    stay intercepted after the mode exits (the reference keeps the Fake key
+    in the tensor's key set; mode state is TLS but handler registration is
+    global — fake.cc:554,588,546-548).  ``uninstall`` exists for tests."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self._saved: list[tuple[Any, str, Any]] = []
+
+    def ensure_installed(self) -> None:
+        with self._lock:
+            if self._installed:
+                return
+            self._installed = True
+            for name in dir(jnp):
+                if name.startswith("_") or name in _METADATA_PASSTHROUGH:
+                    continue
+                orig = getattr(jnp, name, None)
+                if orig is None or not _wrappable(orig):
+                    continue
+                wrapper = _make_wrapper(name, orig, name in _JNP_CREATION)
+                if _is_ufunc_like(orig):
+                    wrapper = _InterposedUfunc(wrapper, orig)
+                self._saved.append((jnp, name, orig))
+                setattr(jnp, name, wrapper)
+            for name in _RANDOM_CREATION:
+                orig = getattr(jax.random, name, None)
+                if orig is None or not _wrappable(orig):
+                    continue
+                wrapper = _make_wrapper(f"random_{name}", orig, True)
+                self._saved.append((jax.random, name, orig))
+                setattr(jax.random, name, wrapper)
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if not self._installed:
+                return
+            self._installed = False
+            for mod, name, orig in self._saved:
+                setattr(mod, name, orig)
+            self._saved.clear()
+
+
+_patcher = _Patcher()
+ensure_installed = _patcher.ensure_installed
+uninstall = _patcher.uninstall
