@@ -1,0 +1,59 @@
+"""Host-RAM discipline regression for the sharded materialization path
+(BASELINE.json config 3; VERDICT round-1 weak #8).
+
+The claim (interop/torch_interop.py:8-10, _graph.py replay docstring): the
+replay path stages O(one tensor) of host memory, never a full model copy.
+On the 8-virtual-device CPU mesh the "device" buffers themselves live in
+host RAM, so the observable bound is
+
+    RSS delta  <=  total param bytes  +  one-tensor slack
+
+i.e. materialization must not double-buffer (host copy + device copy).  On
+a real TPU the same machinery measures ~0.23 GB host RSS for a 13.5 GB
+model (bench.py), which is the stronger form of the claim.
+
+The measurement runs in a FRESH subprocess (scripts/bench_baseline_configs
+config 3): ru_maxrss is a process-lifetime high-water mark, so measuring
+inside the long-lived pytest process would let any earlier memory peak make
+the bound vacuously pass.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_sharded_materialize_rss_bound():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "bench_baseline_configs.py"),
+            "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    cfg3 = next(r for r in rows if r.get("config") == 3)
+    delta = cfg3["peak_host_rss_delta_gb"]
+    params_gb = cfg3["param_bytes_gb"]
+    # one-tensor slack: tok_emb (50257 x 1280 x 4B ~ 0.26 GB) + allocator
+    # headroom; a double-buffered implementation would show ~2x params
+    assert delta < params_gb + 0.8, (
+        f"sharded materialize RSS delta {delta:.2f} GB exceeds params "
+        f"({params_gb:.2f} GB) + one-tensor slack — host-RAM discipline "
+        "regression (O(one-tensor) staging claim)"
+    )
+    # and the sharded path really fanned out over 8 devices
+    assert cfg3["n_devices"] == 8

@@ -78,3 +78,64 @@ def test_invalid_topk():
 
     with pytest.raises(ValueError, match="top_k"):
         MoE(8, 16, n_experts=4, top_k=5)
+
+
+class TestCapacityDispatch:
+    """Capacity-based token dispatch must equal the dense path when no
+    token can be dropped (capacity_factor >= E / top_k), and must drop the
+    overflow (zero combine weight) when capacity is tight."""
+
+    def test_matches_dense_when_capacity_sufficient(self):
+        tdx.manual_seed(5)
+        dense = tdx.deferred_init(MoE, 16, 32, 4, 2)
+        tdx.materialize_module(dense)
+        params = dict(dense.named_parameters())
+
+        disp = MoE(16, 32, 4, 2, capacity_factor=4 / 2)  # C = n: no drops
+        disp.load_state_dict(params)
+
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(3, 8, 16).astype(np.float32)
+        )
+        y_dense = dense(x)
+        y_disp = disp(x)
+        np.testing.assert_allclose(
+            np.asarray(y_dense), np.asarray(y_disp), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gradients_flow(self):
+        tdx.manual_seed(6)
+        m = MoE(8, 16, 4, 2, capacity_factor=2.0)
+        params = dict(m.named_parameters())
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 8).astype(np.float32))
+
+        def loss(p):
+            return jnp.mean(functional_call(m, p, (x,)) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert all(jnp.all(jnp.isfinite(v)) for v in g.values())
+        assert float(jnp.abs(g["w_gate"]).sum()) > 0
+
+    def test_tight_capacity_drops_tokens(self):
+        tdx.manual_seed(7)
+        # capacity_factor tiny -> C = 1: most tokens dropped, output is
+        # partial but finite; combine weights for dropped tokens are zero
+        m = MoE(8, 16, 4, 1, capacity_factor=0.1)
+        x = jnp.asarray(np.random.RandomState(2).randn(16, 8).astype(np.float32))
+        y = m(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+        # at least one token passed through, not all
+        norms = jnp.linalg.norm(y, axis=-1)
+        assert float(jnp.max(norms)) > 0
+        assert float(jnp.min(norms)) == 0.0
+
+    def test_ep_sharded_dispatch(self):
+        mesh = create_mesh({"ep": 4}, devices=jax.devices()[:4])
+        tdx.manual_seed(8)
+        m = tdx.deferred_init(MoE, 16, 32, 4, 2, capacity_factor=2.0)
+        tdx.materialize_module(m, sharding_rule=moe_shard_rule(mesh, "ep"))
+        x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 16).astype(np.float32))
+        y = m(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(np.asarray(y))))

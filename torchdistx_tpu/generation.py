@@ -1,23 +1,74 @@
 """Autoregressive generation with a static-shape KV cache.
 
-Works with any model exposing ``init_cache(batch, max_seq)`` and
-``forward_cached(tokens, cache, cache_pos) -> (logits, cache)`` (Llama
-ships both).  The whole decode — prefill plus a ``lax.scan`` over new
-tokens — runs inside one jitted, static-shape computation, so there is one
-compile per (batch, prompt_len, max_new_tokens) signature and the per-token
+``generate`` drives decoder-only models exposing ``init_cache(batch,
+max_seq)`` and ``forward_cached(tokens, cache, cache_pos) -> (logits,
+cache)`` (Llama and GPT-2 ship both).  ``generate_encdec`` drives
+encoder-decoder models exposing ``encode``, ``init_decoder_cache(enc,
+max_seq)`` and ``decode_step`` (T5).  In both, the whole decode — prefill
+plus a ``lax.scan`` over new tokens — runs inside one jitted, static-shape
+computation, so there is one compile per call signature and the per-token
 step is a single cached executable.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 from .nn.module import functional_call
 
-__all__ = ["generate"]
+__all__ = ["generate", "generate_encdec"]
+
+
+def _make_sampler(temperature: float, out_dtype):
+    def sample(logits_1, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_1, axis=-1).astype(out_dtype)
+        scaled = logits_1.astype(jnp.float32) / temperature
+        return jax.random.categorical(k, scaled, axis=-1).astype(out_dtype)
+
+    return sample
+
+
+def _decode_tokens(
+    apply_step: Callable[[jax.Array, Any, Any], tuple],
+    sample,
+    cache,
+    last_logits: jax.Array,
+    key: jax.Array,
+    n_new: int,
+    pos0,
+) -> jax.Array:
+    """Sample ``n_new`` tokens with a scan.  ``apply_step(tok_col, cache,
+    pos)`` runs one cached decode step at position ``pos = pos0 + i``;
+    ``last_logits`` is (B, V) for the first token.  Returns (B, n_new)."""
+
+    def step(carry, i):
+        cache, last, k = carry
+        k, sub = jax.random.split(k)
+        tok = sample(last, sub)
+        logits, cache = apply_step(tok[:, None], cache, pos0 + i)
+        return (cache, logits[:, -1], k), tok
+
+    (_, last, key2), toks = jax.lax.scan(
+        step, (cache, last_logits, key), jnp.arange(n_new - 1)
+    )
+    _, sub = jax.random.split(key2)
+    final_tok = sample(last, sub)
+    return jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), final_tok[:, None]], axis=1
+    )
+
+
+def _cached_jit(model, store: str, cache_key, build):
+    # jit cache lives ON the model so executables (which close over the
+    # model) are collected with it rather than pinned by a module global
+    builders = model.__dict__.setdefault(store, {})
+    if cache_key not in builders:
+        builders[cache_key] = jax.jit(build)
+    return builders[cache_key]
 
 
 def generate(
@@ -41,66 +92,96 @@ def generate(
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return prompt
+    max_new = int(max_new_tokens)
     cfg = getattr(model, "cfg", None)
     limit = getattr(cfg, "max_seq_len", None) or getattr(
         cfg, "n_positions", None
     )
-    if limit is not None and s + max_new_tokens > limit:
+    if limit is not None and s + max_new > limit:
         # RoPE/positional tables clamp silently past the end; fail loudly
         raise ValueError(
-            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"prompt ({s}) + max_new_tokens ({max_new}) exceeds the "
             f"model's maximum sequence length {limit}"
         )
 
-    jitted = _build(model, b, s, int(max_new_tokens), float(temperature))
+    def run(params, prompt, key):
+        def apply_step(tokens, cache, pos):
+            return functional_call(
+                model, params, (tokens, cache, pos), method="forward_cached"
+            )
+
+        cache = model.init_cache(b, s + max_new)
+        logits, cache = apply_step(prompt, cache, 0)
+        toks = _decode_tokens(
+            apply_step,
+            _make_sampler(temperature, prompt.dtype),
+            cache,
+            logits[:, -1],
+            key,
+            max_new,
+            s,
+        )
+        return jnp.concatenate([prompt, toks], axis=1)
+
+    jitted = _cached_jit(
+        model, "_generate_cache", (b, s, max_new, float(temperature)), run
+    )
     return jitted(params, prompt, key)
 
 
-def _build(model, b: int, s: int, max_new: int, temperature: float):
-    # cache lives ON the model so jitted executables (which close over the
-    # model) are collected with it rather than pinned by a module global
-    builders = model.__dict__.setdefault("_generate_cache", {})
-    cache_key = (b, s, max_new, temperature)
-    if cache_key in builders:
-        return builders[cache_key]
+def generate_encdec(
+    model: Any,
+    enc_tokens: jax.Array,
+    max_new_tokens: int,
+    *,
+    start_token: int = 0,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    params: Optional[dict] = None,
+) -> jax.Array:
+    """Encoder-decoder generation (T5-style).
 
-    max_seq = s + max_new
+    The encoder runs once; every decode step reuses the cached encoder K/V
+    and the causal self-attention cache.  Decoding starts from
+    ``start_token`` (T5's convention: the pad token, id 0) and returns the
+    (B, max_new_tokens) generated ids (start token excluded).
+    """
+    if max_new_tokens <= 0:
+        raise ValueError("max_new_tokens must be positive")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    params = params if params is not None else dict(model.named_parameters())
+    key = key if key is not None else jax.random.PRNGKey(0)
+    b = enc_tokens.shape[0]
+    max_new = int(max_new_tokens)
 
-    def run(params, prompt, key):
-        def apply_cached(p, tokens, cache, pos):
-            return functional_call(
-                model, p, (tokens, cache, pos), method="forward_cached"
-            )
+    def run(params, enc_tokens, key):
+        def call(method, *args):
+            return functional_call(model, params, args, method=method)
 
-        cache = model.init_cache(b, max_seq)
-        logits, cache = apply_cached(params, prompt, cache, 0)
-        last = logits[:, -1]
+        def apply_step(tokens, cache, pos):
+            return call("decode_step", tokens, cache, pos)
 
-        def sample(logits_1, k):
-            if temperature <= 0.0:
-                return jnp.argmax(logits_1, axis=-1).astype(prompt.dtype)
-            scaled = logits_1.astype(jnp.float32) / temperature
-            return jax.random.categorical(k, scaled, axis=-1).astype(
-                prompt.dtype
-            )
-
-        def step(carry, i):
-            cache, last_logits, k = carry
-            k, sub = jax.random.split(k)
-            tok = sample(last_logits, sub)
-            logits, cache = apply_cached(params, tok[:, None], cache, s + i)
-            return (cache, logits[:, -1], k), tok
-
-        (_, last_logits, key2), toks = jax.lax.scan(
-            step, (cache, last, key), jnp.arange(max_new - 1)
+        enc = call("encode", enc_tokens)
+        # the cache carries weight-derived parts (encoder K/V), so it must
+        # be built under the functional params too
+        cache = call("init_decoder_cache", enc, max_new)
+        tok0 = jnp.full((b, 1), start_token, jnp.int32)
+        logits, cache = apply_step(tok0, cache, 0)
+        return _decode_tokens(
+            apply_step,
+            _make_sampler(temperature, jnp.int32),
+            cache,
+            logits[:, -1],
+            key,
+            max_new,
+            1,
         )
-        k_final, sub = jax.random.split(key2)
-        final_tok = sample(last_logits, sub)
-        out = jnp.concatenate(
-            [prompt, jnp.moveaxis(toks, 0, 1), final_tok[:, None]], axis=1
-        )
-        return out
 
-    jitted = jax.jit(run)
-    builders[cache_key] = jitted
-    return jitted
+    jitted = _cached_jit(
+        model,
+        "_generate_encdec_cache",
+        (b, enc_tokens.shape[1], max_new, float(temperature), start_token),
+        run,
+    )
+    return jitted(params, enc_tokens, key)

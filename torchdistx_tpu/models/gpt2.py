@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn import init
-from ..ops.attention import multihead_attention
+from ..ops.attention import cached_attention, multihead_attention
 
 __all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
 
@@ -74,6 +74,19 @@ class GPT2Block(nn.Module):
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h)))
 
+    def forward_cached(self, x, cache, cache_pos):
+        """Incremental attention against a static-shape KV cache — same
+        contract as the Llama blocks (ops.attention.cached_attention)."""
+        b, s, d = x.shape
+        hd = d // self.n_heads
+        h = self.ln1(x)
+        qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a, cache = cached_attention(q, k, v, cache, cache_pos)
+        x = x + self.attn_out(a.reshape(b, s, d))
+        h = self.ln2(x)
+        return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache
+
 
 class GPT2(nn.Module):
     def __init__(self, cfg: GPT2Config):
@@ -106,3 +119,30 @@ class GPT2(nn.Module):
         x = self.ln_f(x)
         # weight-tied head (GPT-2 ties lm_head to tok_emb)
         return x @ self.tok_emb.weight.T
+
+    # -- KV-cache decode (generation.generate contract, like Llama) -------
+
+    def init_cache(self, batch_size: int, max_seq=None):
+        """Per-layer (k, v) caches of static shape (B, max_seq, H, D)."""
+        cfg = self.cfg
+        max_seq = max_seq or cfg.n_positions
+        shape = (
+            batch_size, max_seq, cfg.n_heads, cfg.dim // cfg.n_heads,
+        )
+        return [
+            (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.n_layers)
+        ]
+
+    def forward_cached(self, tokens, cache, cache_pos):
+        """Run ``tokens`` (prefill chunk or single decode token) against the
+        cache starting at ``cache_pos``.  Returns (logits, new_cache)."""
+        s = tokens.shape[1]
+        pos = cache_pos + jnp.arange(s)
+        x = self.tok_emb(tokens) + self.pos_emb(pos)[None]
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk.forward_cached(x, c, cache_pos)
+            new_cache.append(c)
+        x = self.ln_f(x)
+        return x @ self.tok_emb.weight.T, new_cache

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn import init as nn_init
-from ..ops.attention import multihead_attention, ring_attention
+from ..ops.attention import cached_attention, multihead_attention, ring_attention
 
 __all__ = ["LlamaConfig", "Llama", "llama_configs"]
 
@@ -40,7 +40,11 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block
     sp_axis: Optional[str] = None  # ring attention over this mesh axis
-    use_flash: bool = False  # pallas flash-attention kernel (single chip)
+    # pallas flash-attention kernel (single chip).  None = auto: on for TPU
+    # (measured 2-5x over the jnp path at 2k-4k and the only path that runs
+    # at 8k+, scripts/bench_flash_attention.py), off elsewhere (the CPU
+    # fallback is interpret-mode pallas — exact but slow).
+    use_flash: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.n_kv_heads is None:
@@ -64,6 +68,12 @@ llama_configs = {
     "tiny": dict(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, max_seq_len=128,
         dtype=jnp.float32,
+    ),
+    # 1B-class config sized to train on ONE v5e chip (16 GB HBM) with
+    # AnyPrecisionAdamW state + remat — the single-chip throughput bench
+    "llama_1b": dict(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+        max_seq_len=2048, remat=True,
     ),
     "llama2_7b": dict(
         vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
@@ -122,9 +132,12 @@ class LlamaAttention(nn.Module):
             pos_offset = jax.lax.axis_index(cfg.sp_axis) * s
         q = apply_rope(q, rope, pos_offset)
         k = apply_rope(k, rope, pos_offset)
+        use_flash = cfg.use_flash
+        if use_flash is None:
+            use_flash = jax.devices()[0].platform == "tpu"
         if cfg.sp_axis is not None:
             out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
-        elif cfg.use_flash:
+        elif use_flash:
             from ..ops.flash_attention import flash_attention
 
             # flash_attention reduces block sizes to dividing values itself
@@ -140,8 +153,6 @@ class LlamaAttention(nn.Module):
         values are written at ``cache_pos`` (traced) and attention masks out
         slots beyond ``cache_pos + s``.  Returns (out, new_cache).
         """
-        import math as _math
-
         b, s, _ = x.shape
         cfg = self.cfg
         q = self.wq(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
@@ -149,25 +160,8 @@ class LlamaAttention(nn.Module):
         v = self.wv(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, rope, cache_pos)
         k = apply_rope(k, rope, cache_pos)
-        ck, cv = cache
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_pos, 0, 0))
-        max_seq = ck.shape[1]
-        n_rep = cfg.n_heads // cfg.n_kv_heads
-        kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
-        vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
-        scale = 1.0 / _math.sqrt(cfg.head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
-        # slot j visible to query i iff j <= cache_pos + i
-        visible = (
-            jnp.arange(max_seq)[None, :]
-            <= cache_pos + jnp.arange(s)[:, None]
-        )
-        logits = jnp.where(visible[None, None], logits, -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
-        out = self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))
-        return out, (ck, cv)
+        out, cache = cached_attention(q, k, v, cache, cache_pos)
+        return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 
 
 class LlamaMLP(nn.Module):

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..ops.attention import cached_attention
 
 __all__ = ["T5Config", "T5", "t5_configs"]
 
@@ -92,6 +93,34 @@ class T5Attention(nn.Module):
         )
         return jnp.transpose(self.rel_bias(bucket), (2, 0, 1))  # (H, Sq, Skv)
 
+    def forward_cached_self(self, x, cache, cache_pos, bias):
+        """Incremental causal self-attention against a (k, v) cache.
+
+        ``bias`` is the (H, sq, max_seq) slice of the relative-position
+        bias for the rows being decoded (computed once per step at the
+        stack level and shared by every layer, like ``forward``).
+        """
+        cfg = self.cfg
+        b, sq, _ = x.shape
+        q = self.q(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
+        k = self.k(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
+        v = self.v(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
+        # T5 uses unscaled dot products (scale folded into init)
+        out, cache = cached_attention(
+            q, k, v, cache, cache_pos, scale=1.0, bias=bias
+        )
+        return self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)), cache
+
+    def forward_cross_cached(self, x, ke, ve):
+        """Cross-attention with the encoder K/V projected once up front."""
+        cfg = self.cfg
+        b, sq, _ = x.shape
+        q = self.q(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ke).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, ve)
+        return self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv))
+
     def forward(self, x, kv=None, causal=False, bias=None):
         cfg = self.cfg
         b, sq, _ = x.shape
@@ -136,6 +165,17 @@ class T5Block(nn.Module):
             c, _ = self.cross_attn(self.ln_cross(x), kv=enc)
             x = x + c
         return x + self.wo(F.relu(self.wi(self.ln2(x)))), bias
+
+    def decode_step(self, x, cache, cache_pos, bias):
+        """Incremental decoder block: cached causal self-attention +
+        cross-attention over pre-projected encoder K/V."""
+        ck, cv, ke, ve = cache
+        a, (ck, cv) = self.self_attn.forward_cached_self(
+            self.ln1(x), (ck, cv), cache_pos, bias
+        )
+        x = x + a
+        x = x + self.cross_attn.forward_cross_cached(self.ln_cross(x), ke, ve)
+        return x + self.wo(F.relu(self.wi(self.ln2(x)))), (ck, cv, ke, ve)
 
 
 class T5(nn.Module):
@@ -184,3 +224,55 @@ class T5(nn.Module):
         x = self.dec_norm(x)
         # tied output head with T5's 1/sqrt(dim) scaling
         return (x * (self.cfg.dim**-0.5)) @ self.shared_emb.weight.T
+
+    # -- incremental encoder-decoder decode (generation.generate_encdec) --
+
+    def init_decoder_cache(self, enc, max_seq: int):
+        """Per-decoder-layer cache: causal self-attn (k, v) of static shape
+        (B, max_seq, H, d_kv) plus the encoder K/V projected ONCE per layer
+        (cross-attention reuses them every step)."""
+        cfg = self.cfg
+        b, s_enc, _ = enc.shape
+        shape = (b, max_seq, cfg.n_heads, cfg.d_kv)
+        caches = []
+        for blk in self.dec_blocks:
+            ke = blk.cross_attn.k(enc).reshape(b, s_enc, cfg.n_heads, cfg.d_kv)
+            ve = blk.cross_attn.v(enc).reshape(b, s_enc, cfg.n_heads, cfg.d_kv)
+            caches.append(
+                (
+                    jnp.zeros(shape, cfg.dtype),
+                    jnp.zeros(shape, cfg.dtype),
+                    ke,
+                    ve,
+                )
+            )
+        return caches
+
+    def _decoder_bias_slice(self, sq: int, max_seq: int, cache_pos):
+        """Relative-position bias rows for decode positions
+        ``cache_pos + [0, sq)`` against all ``max_seq`` cache slots —
+        the incremental slice of the first decoder layer's shared bias."""
+        layer0 = self.dec_blocks[0].self_attn
+        ctx = (cache_pos + jnp.arange(sq))[:, None]
+        mem = jnp.arange(max_seq)[None, :]
+        bucket = _rel_pos_bucket(
+            mem - ctx,
+            bidirectional=False,
+            buckets=self.cfg.rel_pos_buckets,
+            max_dist=self.cfg.rel_pos_max_dist,
+        )
+        return jnp.transpose(layer0.rel_bias(bucket), (2, 0, 1))
+
+    def decode_step(self, dec_tokens, cache, cache_pos):
+        """Run a prefill chunk or single decode token against the cache.
+        Returns (logits, new_cache)."""
+        sq = dec_tokens.shape[1]
+        max_seq = cache[0][0].shape[1]
+        x = self.shared_emb(dec_tokens)
+        bias = self._decoder_bias_slice(sq, max_seq, cache_pos)
+        new_cache = []
+        for blk, c in zip(self.dec_blocks, cache):
+            x, c = blk.decode_step(x, c, cache_pos, bias)
+            new_cache.append(c)
+        x = self.dec_norm(x)
+        return (x * (self.cfg.dim**-0.5)) @ self.shared_emb.weight.T, new_cache

@@ -7,10 +7,22 @@ over an ``ep`` mesh axis and XLA partitions the expert einsums and inserts
 the combine reduction.  Construction goes through the interposition layer,
 so MoE models deferred-init and sharded-materialize like everything else.
 
-Routing is top-k softmax gating with renormalized weights; the forward
-computes experts densely and masks the combine (exact, simple, and
-partition-friendly — the token-dropping dispatch variants are a later
-optimization, not a semantics change).
+Routing is top-k softmax gating with renormalized weights.  Two compute
+modes:
+
+  - dense (default): every expert computes every token; the combine is
+    masked.  Exact and simple, but E/top_k times the dispatched FLOPs.
+  - capacity dispatch (``capacity_factor=``): the Mesh-TensorFlow /
+    Switch algorithm — each expert receives at most
+    ``C = ceil(tokens * top_k / E * capacity_factor)`` tokens, gathered by
+    a dispatch tensor and computed as (E, C, D) batches.  FLOPs drop to
+    ~``top_k/E`` of dense; tokens beyond an expert's capacity are dropped
+    (their combine weight is zero), which is the standard MoE trade.
+    Under an ``ep`` sharding the dispatch/combine einsums become XLA
+    all-to-alls over the expert axis — the TPU-native token shuffle.
+
+With ``capacity_factor >= E / top_k`` no token can be dropped and the two
+modes agree exactly (tested).
 """
 
 from __future__ import annotations
@@ -41,6 +53,7 @@ class MoE(Module):
         n_experts: int,
         top_k: int = 2,
         dtype=jnp.float32,
+        capacity_factor: Optional[float] = None,
     ) -> None:
         super().__init__()
         if not 1 <= top_k <= n_experts:
@@ -49,6 +62,7 @@ class MoE(Module):
         self.ffn_dim = ffn_dim
         self.n_experts = n_experts
         self.top_k = top_k
+        self.capacity_factor = capacity_factor
         self.router = Linear(dim, n_experts, bias=False, dtype=dtype)
         bound = math.sqrt(1.0 / dim)
         self.w_gate = Parameter(
@@ -73,6 +87,15 @@ class MoE(Module):
         load-balancing auxiliary loss computed from the SAME routing pass
         (no second router forward)."""
         probs = self._route(x)
+        if self.capacity_factor is not None:
+            y = self._capacity_forward(x, probs)
+        else:
+            y = self._dense_forward(x, probs)
+        if return_aux:
+            return y, self._balance_loss(probs)
+        return y
+
+    def _dense_forward(self, x, probs):
         top_p, top_i = jax.lax.top_k(probs, self.top_k)
         top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
         # combine weights as a dense (..., E) mask — partition-friendly
@@ -83,10 +106,51 @@ class MoE(Module):
         h_up = jnp.einsum("...d,edf->...ef", x, self.w_up)
         h = jax.nn.silu(h_gate) * h_up
         expert_out = jnp.einsum("...ef,efd->...ed", h, self.w_down)
-        y = jnp.einsum("...e,...ed->...d", combine.astype(x.dtype), expert_out)
-        if return_aux:
-            return y, self._balance_loss(probs)
-        return y
+        return jnp.einsum("...e,...ed->...d", combine.astype(x.dtype), expert_out)
+
+    def _capacity_forward(self, x, probs):
+        """Capacity-based token dispatch (Mesh-TF/Switch): experts compute
+        (E, C, D) gathered batches instead of every token.  Priority runs
+        top-1 slots before top-2 across all tokens, then by token order —
+        the standard GShard discipline."""
+        e, k = self.n_experts, self.top_k
+        lead = x.shape[:-1]
+        d = x.shape[-1]
+        xf = x.reshape(-1, d)
+        pf = probs.reshape(-1, e)
+        n = xf.shape[0]
+        cap = int(math.ceil(n * k / e * float(self.capacity_factor)))
+        cap = min(cap, n)
+
+        top_p, top_i = jax.lax.top_k(pf, k)
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+        dispatch = jnp.zeros((n, e, cap), x.dtype)
+        combine = jnp.zeros((n, e, cap), x.dtype)
+        counts = jnp.zeros((e,), jnp.int32)
+        for j in range(k):  # static, small
+            oh = jax.nn.one_hot(top_i[:, j], e, dtype=jnp.int32)  # (n, E)
+            pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]  # (n, E)
+            pos_t = jnp.sum(oh * pos, axis=-1)  # (n,) position in expert
+            keep = pos_t < cap
+            slot = jax.nn.one_hot(
+                jnp.where(keep, pos_t, 0), cap, dtype=x.dtype
+            )  # (n, C)
+            sel = oh.astype(x.dtype) * keep[:, None].astype(x.dtype)
+            dispatch = dispatch + sel[:, :, None] * slot[:, None, :]
+            combine = combine + (
+                sel * top_p[:, j][:, None].astype(x.dtype)
+            )[:, :, None] * slot[:, None, :]
+            counts = counts + jnp.sum(oh, axis=0)
+
+        # (n, E, C) x (n, D) -> (E, C, D): the all-to-all under ep sharding
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, self.w_gate)
+        ) * jnp.einsum("ecd,edf->ecf", expert_in, self.w_up)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, self.w_down)
+        y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        return y.reshape(*lead, d)
 
     def _balance_loss(self, probs) -> jax.Array:
         me = jnp.mean(probs.reshape(-1, self.n_experts), axis=0)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["multihead_attention", "ring_attention"]
+__all__ = ["multihead_attention", "ring_attention", "cached_attention"]
 
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -28,6 +28,52 @@ def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
         return k
     b, s, h, d = k.shape
     return jnp.repeat(k, n_rep, axis=2)
+
+
+def cached_attention(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    cache: tuple,
+    cache_pos,
+    *,
+    scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+):
+    """Incremental attention against a static-shape KV cache — the shared
+    decode primitive behind every model's ``forward_cached``.
+
+    ``q``/``k_new``/``v_new``: (B, S, H, D) projections of the new tokens
+    (any positional encoding already applied).  ``cache`` is ``(k, v)`` of
+    shape (B, max_seq, Hkv, D); the new keys/values are written at
+    ``cache_pos`` (traced) and slot ``j`` is visible to query ``i`` iff
+    ``j <= cache_pos + i``.  GQA-aware (Hq a multiple of Hkv).  ``scale``
+    defaults to 1/sqrt(D) (pass 1.0 for T5's unscaled dot products);
+    ``bias`` is an optional (H, S, max_seq) additive logit bias (T5's
+    relative-position bias).  f32 softmax.  Returns (out, (ck, cv)).
+    """
+    b, s, hq, d = q.shape
+    ck, cv = cache
+    ck = lax.dynamic_update_slice(
+        ck, k_new.astype(ck.dtype), (0, cache_pos, 0, 0)
+    )
+    cv = lax.dynamic_update_slice(
+        cv, v_new.astype(cv.dtype), (0, cache_pos, 0, 0)
+    )
+    max_seq, hkv = ck.shape[1], ck.shape[2]
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias[None].astype(jnp.float32)
+    visible = (
+        jnp.arange(max_seq)[None, :] <= cache_pos + jnp.arange(s)[:, None]
+    )
+    logits = jnp.where(visible[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    return out, (ck, cv)
 
 
 def multihead_attention(

@@ -99,6 +99,7 @@ class Trainer:
         metrics."""
         t_window = time.time()
         window_steps = 0
+        warmup_pending = True  # first step carries jit compile time
         loss = None  # device array; only realized at log boundaries / return
         it = iter(batches)
         while True:
@@ -116,7 +117,17 @@ class Trainer:
             self.global_step += 1
             window_steps += 1
 
-            if self.global_step % self.log_every == 0:
+            if warmup_pending:
+                # exclude the first step's jit compile from throughput
+                # windows: wait for it, then restart the clock
+                jax.block_until_ready(loss)
+                t_window = time.time()
+                window_steps = 0
+                warmup_pending = False
+
+            # window_steps == 0 right after the warmup reset (log_every=1):
+            # skip that boundary instead of logging 0.0 steps/sec
+            if self.global_step % self.log_every == 0 and window_steps > 0:
                 jax.block_until_ready(loss)
                 dt = time.time() - t_window
                 last_loss = float(loss)
