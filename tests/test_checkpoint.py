@@ -129,3 +129,39 @@ def test_slowmo_state_dict_checkpoint(tmp_path):
     np.testing.assert_allclose(
         np.asarray(opt2.state.prev_params["w"]), np.ones(4)
     )
+
+
+def test_streaming_restore_into_template_shardings(tmp_path, mesh8):
+    """shardings_from=: every restored array streams directly into the
+    template leaf's sharding (the sharded map_location, without a
+    replicated host copy in between), including optimizer NamedTuples."""
+    import optax
+
+    from torchdistx_tpu.parallel import fsdp_shard_rule
+    from torchdistx_tpu.parallel.fsdp import optimizer_state_shardings
+
+    rule = fsdp_shard_rule(mesh8, "fsdp")
+    w = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+        rule("w", jax.ShapeDtypeStruct((8, 8), jnp.float32)),
+    )
+    params = {"w": w}
+    tx = optax.adam(1e-3)
+    state_shape = jax.eval_shape(tx.init, params)
+    opt_state = jax.jit(
+        tx.init,
+        out_shardings=optimizer_state_shardings(state_shape, params, mesh8),
+    )(params)
+    state = {"params": params, "opt_state": opt_state, "global_step": 7}
+    path = str(tmp_path / "stream")
+    save_checkpoint(path, state)
+
+    out = restore_checkpoint(path, shardings_from=state)
+    assert out["params"]["w"].sharding.is_equivalent_to(w.sharding, 2)
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]), np.asarray(w))
+    # optimizer slots (restored as plain nests) landed sharded too
+    mu = out["opt_state"]["0"]["mu"]["w"] if isinstance(
+        out["opt_state"], dict
+    ) else jax.tree_util.tree_leaves(out["opt_state"])[1]
+    assert len(mu.sharding.device_set) == 8
+    assert int(out["global_step"]) == 7

@@ -1,0 +1,187 @@
+"""Failure detection + elastic recovery (SURVEY §5.3 — absent in the
+reference; this framework provides the host-side half of elasticity)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.nn import functional_call
+from torchdistx_tpu.trainer import Trainer
+from torchdistx_tpu.utils.failure import (
+    FailureDetector,
+    Heartbeat,
+    StepFailure,
+    guard_nonfinite_updates,
+)
+
+
+class TestFailureDetector:
+    def test_finite_losses_pass(self):
+        det = FailureDetector()
+        for i, loss in enumerate([1.0, 0.5, 0.25]):
+            det.check_loss(i, loss)
+        assert det.failures == []
+
+    def test_nan_raises_at_zero_tolerance(self):
+        det = FailureDetector()
+        with pytest.raises(StepFailure, match="non-finite"):
+            det.check_loss(5, float("nan"))
+        assert det.failures[0]["kind"] == "nonfinite"
+
+    def test_tolerance_allows_transients(self):
+        det = FailureDetector(nan_tolerance=2)
+        det.check_loss(1, float("inf"))
+        det.check_loss(2, float("nan"))
+        det.check_loss(3, 0.7)  # recovered: counter resets
+        det.check_loss(4, float("nan"))
+        det.check_loss(5, float("nan"))
+        with pytest.raises(StepFailure):
+            det.check_loss(6, float("nan"))
+
+    def test_reset_restores_tolerance(self):
+        # a HANDLED failure must not void the tolerance for the rest of
+        # the run
+        det = FailureDetector(nan_tolerance=1)
+        det.check_loss(1, float("nan"))
+        with pytest.raises(StepFailure):
+            det.check_loss(2, float("nan"))
+        det.reset()
+        det.check_loss(3, float("nan"))  # within tolerance again
+
+    def test_window_deadline(self):
+        det = FailureDetector(step_deadline_s=0.01)
+        with pytest.raises(StepFailure, match="deadline|budget"):
+            det.check_window(10, elapsed_s=0.5, n_steps=4)  # 0.5 > 0.04
+        det.check_window(11, elapsed_s=0.03, n_steps=4)  # within budget
+        with pytest.raises(StepFailure):
+            with det.deadline():
+                time.sleep(0.05)
+
+
+class TestGuardNonfiniteUpdates:
+    def test_nonfinite_grads_apply_no_update(self):
+        params = {"w": jnp.ones((4,))}
+        tx = guard_nonfinite_updates(optax.sgd(0.1))
+        s = tx.init(params)
+        bad = {"w": jnp.full((4,), float("nan"))}
+        u, s = tx.update(bad, s, params)
+        p2 = optax.apply_updates(params, u)
+        np.testing.assert_array_equal(np.asarray(p2["w"]), np.ones(4))
+        good = {"w": jnp.ones((4,))}
+        u, s = tx.update(good, s, params)
+        p3 = optax.apply_updates(params, u)
+        assert float(p3["w"][0]) != 1.0  # real update applied
+
+
+class TestHeartbeat:
+    def test_stamps_and_staleness(self, tmp_path):
+        path = str(tmp_path / "hb")
+        hb = Heartbeat(path, interval_s=0.05)
+        with hb:
+            hb.step = 42
+            time.sleep(0.15)
+            assert not Heartbeat.is_stale(path, max_age_s=5.0)
+        with open(path) as f:
+            stamp, step = f.read().split()
+        assert step in ("0", "42")
+        assert Heartbeat.is_stale(path, max_age_s=0.0)
+        assert Heartbeat.is_stale(str(tmp_path / "missing"), 5.0)
+
+
+def _make_trainer(tmp_path, inject_nan_after, on_failure, detector):
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    tdx.manual_seed(0)
+    m = tdx.deferred_init(M)
+    tdx.materialize_module(m)
+    params = dict(m.named_parameters())
+    tx = optax.sgd(1e-2)
+
+    counter = {"n": 0}
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((functional_call(m, p, (xb,)) - yb) ** 2)
+
+    def step(p, s, batch):
+        counter["n"] += 1
+        l, g = jax.value_and_grad(loss_fn)(p, batch)
+        u, s = tx.update(g, s, p)
+        p = jax.tree_util.tree_map(lambda a, b: a + b, p, u)
+        if counter["n"] == inject_nan_after:
+            l = l * jnp.float32(float("nan"))
+        return p, s, l
+
+    logs = []
+    tr = Trainer(
+        step,
+        params,
+        tx.init(params),
+        log_every=1,
+        log_fn=logs.append,
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=2,
+        failure_detector=detector,
+        on_failure=on_failure,
+    )
+    return tr, logs
+
+
+class TestElasticTrainer:
+    def test_raise_policy(self, tmp_path):
+        tr, _ = _make_trainer(tmp_path, 4, "raise", FailureDetector())
+        batch = (jnp.ones((2, 4)), jnp.zeros((2, 1)))
+        with pytest.raises(StepFailure):
+            tr.fit([batch] * 8)
+
+    def test_restore_policy_rolls_back(self, tmp_path):
+        tr, logs = _make_trainer(tmp_path, 5, "restore", FailureDetector())
+        batch = (jnp.ones((2, 4)), jnp.zeros((2, 1)))
+        # rollback re-runs steps, so supply more batches than num_steps
+        tr.fit([batch] * 12, num_steps=8)
+        actions = [m for m in logs if "failure" in m]
+        assert actions and actions[0]["action"] == "restored"
+        # rolled back to the step-4 checkpoint, then continued to 8
+        assert tr.global_step == 8
+        for leaf in jax.tree_util.tree_leaves(tr.params):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+
+    def test_continue_policy_logs_and_goes_on(self, tmp_path):
+        tr, logs = _make_trainer(tmp_path, 3, "continue", FailureDetector())
+        batch = (jnp.ones((2, 4)), jnp.zeros((2, 1)))
+        tr.fit([batch] * 6, num_steps=6)
+        actions = [m for m in logs if "failure" in m]
+        assert actions and actions[0]["action"] == "continued"
+        assert tr.global_step == 6
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        # step 2: the earliest health-checked boundary (step 1's boundary is
+        # consumed by the warmup-window reset)
+        tr, _ = _make_trainer(tmp_path, 2, "restore", FailureDetector())
+        tr.checkpoint_dir = None  # never saves
+        batch = (jnp.ones((2, 4)), jnp.zeros((2, 1)))
+        with pytest.raises(StepFailure, match="no checkpoint"):
+            tr.fit([batch] * 4)
+
+    def test_checkpoint_health_gate(self, tmp_path):
+        # tolerance lets the run continue past a NaN boundary; the step-4
+        # checkpoint then coincides with non-finite loss and must be
+        # skipped, not saved as a poisoned rollback target
+        det = FailureDetector(nan_tolerance=10)
+        tr, logs = _make_trainer(tmp_path, 4, "continue", det)
+        batch = (jnp.ones((2, 4)), jnp.zeros((2, 1)))
+        tr.fit([batch] * 6, num_steps=6)
+        skips = [m for m in logs if m.get("checkpoint") == "skipped_nonfinite_loss"]
+        assert skips and skips[0]["step"] == 4

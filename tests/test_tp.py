@@ -94,3 +94,37 @@ def test_tp_fsdp_2d_materialize():
     w = dict(model.named_parameters())["blocks.0.attn.wq.weight"]
     assert w.sharding.spec == P("tp", "fsdp")
     assert len(w.sharding.device_set) == 8
+
+
+def test_mismatched_batch_sharding_warns_once(mesh8):
+    """VERDICT weak #7: a pre-distributed batch whose layout differs from
+    batch_spec is accepted but warned about (once per layout)."""
+    import warnings as _warnings
+
+    from jax.sharding import NamedSharding
+
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    tdx.manual_seed(3)
+    model = tdx.deferred_init(Llama.from_name, "tiny")
+    tdx.materialize_module(model, sharding_rule=llama_tp_rule(mesh, "tp"))
+    params = dict(model.named_parameters())
+
+    def loss_fn(p, batch):
+        t, l = batch
+        return functional.cross_entropy(
+            functional_call(model, p, (t,)), l
+        )
+
+    step = GSPMDTrainStep(
+        loss_fn, optax.sgd(1e-3), mesh, batch_spec=P("dp")
+    )
+    s = step.init_optimizer(params)
+    # distribute the batch over the WRONG axis layout (tp-major)
+    wrong = NamedSharding(mesh, P("tp"))
+    t = jax.device_put(jnp.zeros((8, 16), jnp.int32), wrong)
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        params, s, _ = step(params, s, (t, t))
+        params, s, _ = step(params, s, (t, t))  # same layout: no second warn
+    msgs = [str(w.message) for w in rec if "batch_spec" in str(w.message)]
+    assert len(msgs) == 1  # once per distinct (sharding, shape) layout

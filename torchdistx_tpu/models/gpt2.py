@@ -16,6 +16,7 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import init
 from ..ops.attention import cached_attention, multihead_attention
+from ..ops.flash_attention import resolve_use_flash
 
 __all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
 
@@ -29,6 +30,10 @@ class GPT2Config:
     n_heads: int = 12
     norm_eps: float = 1e-5
     dtype: object = jnp.float32
+    # pallas flash-attention kernel.  None = auto: on for TPU (measured
+    # 2-5x and the only path at 8k+, scripts/bench_flash_attention.py),
+    # off elsewhere (interpret-mode pallas is exact but slow on CPU)
+    use_flash: object = None
 
 
 gpt2_configs = {
@@ -51,6 +56,7 @@ def _zeros_init(s, d):
 class GPT2Block(nn.Module):
     def __init__(self, cfg: GPT2Config):
         super().__init__()
+        self.use_flash = cfg.use_flash
         d = cfg.dim
         # GPT-2 scheme: N(0, 0.02) weights, zero biases, residual output
         # projections scaled by 1/sqrt(2 * n_layers)
@@ -69,7 +75,12 @@ class GPT2Block(nn.Module):
         h = self.ln1(x)
         qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, d // self.n_heads)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        a = multihead_attention(q, k, v, causal=True).reshape(b, s, d)
+        if resolve_use_flash(self.use_flash):
+            from ..ops.flash_attention import flash_attention
+
+            a = flash_attention(q, k, v, causal=True).reshape(b, s, d)
+        else:
+            a = multihead_attention(q, k, v, causal=True).reshape(b, s, d)
         x = x + self.attn_out(a)
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h)))

@@ -22,6 +22,7 @@ from .. import nn
 from ..nn import functional as F
 from ..nn import init as nn_init
 from ..ops.attention import cached_attention, multihead_attention, ring_attention
+from ..ops.flash_attention import resolve_use_flash
 
 __all__ = ["LlamaConfig", "Llama", "llama_configs"]
 
@@ -132,12 +133,9 @@ class LlamaAttention(nn.Module):
             pos_offset = jax.lax.axis_index(cfg.sp_axis) * s
         q = apply_rope(q, rope, pos_offset)
         k = apply_rope(k, rope, pos_offset)
-        use_flash = cfg.use_flash
-        if use_flash is None:
-            use_flash = jax.devices()[0].platform == "tpu"
         if cfg.sp_axis is not None:
             out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
-        elif use_flash:
+        elif resolve_use_flash(cfg.use_flash):
             from ..ops.flash_attention import flash_attention
 
             # flash_attention reduces block sizes to dividing values itself

@@ -201,6 +201,16 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
 _flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def resolve_use_flash(setting: Optional[bool]) -> bool:
+    """Shared model-config policy: ``None`` means auto — flash on TPU
+    (measured 2-5x and the only runnable path at 8k+,
+    scripts/bench_flash_attention.py), the jnp path elsewhere (the CPU
+    fallback is interpret-mode pallas: exact but slow)."""
+    if setting is not None:
+        return bool(setting)
+    return jax.devices()[0].platform == "tpu"
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

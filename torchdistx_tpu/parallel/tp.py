@@ -111,6 +111,7 @@ class GSPMDTrainStep:
             return params, opt_state, loss
 
         self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        self._warned_shardings: set = set()
 
     def init_optimizer(self, params: Any) -> Any:
         state_shape = jax.eval_shape(self.optimizer.init, params)
@@ -134,6 +135,24 @@ class GSPMDTrainStep:
                     len(x.sharding.device_set) > 1
                     and x.sharding.device_set <= mesh_devices
                 ):
+                    # accepted as pre-distributed — but a layout that
+                    # differs from batch_spec makes XLA reshard/gather it
+                    # EVERY step, so say so once per distinct layout
+                    sig = (repr(x.sharding), x.shape)
+                    if sig not in self._warned_shardings:
+                        self._warned_shardings.add(sig)
+                        import warnings
+
+                        warnings.warn(
+                            f"GSPMDTrainStep: batch leaf {x.shape} arrives "
+                            f"with sharding {x.sharding}, not the step's "
+                            f"batch_spec {self.batch_spec}; it is passed "
+                            "through as-is, which can trigger a per-step "
+                            "reshard inside the compiled step. Align the "
+                            "DataLoader's sharding with batch_spec to "
+                            "silence this.",
+                            stacklevel=3,
+                        )
                     return x
             return jax.device_put(x, target)
 

@@ -46,6 +46,8 @@ class Trainer:
         checkpoint_every: int = 1000,
         log_every: int = 50,
         log_fn: Optional[Callable[[dict], None]] = None,
+        failure_detector: Optional[Any] = None,
+        on_failure: str = "raise",
     ) -> None:
         self.step = step
         self.params = params
@@ -57,8 +59,17 @@ class Trainer:
         self.checkpoint_every = checkpoint_every
         self.log_every = log_every
         self.log_fn = log_fn or (lambda m: print(json.dumps(m), flush=True))
+        # failure handling (utils.failure): losses are checked at log
+        # boundaries (where they are realized anyway — zero extra syncs);
+        # on_failure: "raise" | "continue" (log-only) | "restore" (roll
+        # back to the latest health-gated checkpoint — elastic recovery).
+        # For suppressing the poisoned update ITSELF, wrap the optimizer
+        # with utils.failure.guard_nonfinite_updates.
+        self.failure_detector = failure_detector
+        self.on_failure = on_failure
         self.global_step = 0
         self._history: list[float] = []
+        self._last_checkpoint: Optional[str] = None
 
     # -- checkpoint --------------------------------------------------------
 
@@ -74,18 +85,24 @@ class Trainer:
                 "global_step": self.global_step,
             },
         )
+        self._last_checkpoint = path
         return path
 
     def restore(self, path: str) -> None:
-        """Restore params/opt_state/step, re-placing every array onto the
-        sharding the current (template) state carries — so a TP/FSDP run
-        resumes into its mesh layout rather than replicated host arrays."""
-        out = restore_checkpoint(path)
-        self.params = _replace_like(self.params, out["params"])
+        """Restore params/opt_state/step STREAMED into the shardings the
+        current (template) state carries — each array lands directly in its
+        mesh layout, with no replicated host copy in between (the sharded
+        ``map_location`` analog)."""
+        template = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "global_step": 0,
+        }
+        out = restore_checkpoint(path, shardings_from=template)
+        self.params = out["params"]
         # optimizer states are NamedTuples; orbax returns plain nests —
-        # rebuild onto the existing structure (by field name), then re-place
-        restored_opt = _from_tree(self.opt_state, out["opt_state"])
-        self.opt_state = _replace_like(self.opt_state, restored_opt)
+        # rebuild the classes around the already-placed leaves
+        self.opt_state = _from_tree(self.opt_state, out["opt_state"])
         self.global_step = int(out["global_step"])
 
     # -- loop --------------------------------------------------------------
@@ -131,6 +148,32 @@ class Trainer:
                 jax.block_until_ready(loss)
                 dt = time.time() - t_window
                 last_loss = float(loss)
+                if self.failure_detector is not None:
+                    from .utils.failure import StepFailure, apply_failure_policy
+
+                    try:
+                        self.failure_detector.check_loss(
+                            self.global_step, last_loss
+                        )
+                        self.failure_detector.check_window(
+                            self.global_step, dt, window_steps
+                        )
+                    except StepFailure as failure:
+                        failed_step = self.global_step  # before any rollback
+                        action = apply_failure_policy(
+                            self, failure, self.on_failure
+                        )
+                        self.log_fn(
+                            {
+                                "step": failed_step,
+                                "failure": failure.kind,
+                                "action": action,
+                                "resumed_from": self.global_step,
+                            }
+                        )
+                        t_window = time.time()
+                        window_steps = 0
+                        continue
                 metrics = {
                     "step": self.global_step,
                     "loss": round(last_loss, 6),
@@ -149,23 +192,28 @@ class Trainer:
                 self.checkpoint_dir
                 and self.global_step % self.checkpoint_every == 0
             ):
-                self.save()
+                # health-gate: never let poisoned state become the rollback
+                # target of on_failure="restore"
+                healthy = True
+                if self.failure_detector is not None and loss is not None:
+                    jax.block_until_ready(loss)
+                    import math as _math
+
+                    if not _math.isfinite(float(loss)):
+                        healthy = False
+                        self.log_fn(
+                            {
+                                "step": self.global_step,
+                                "checkpoint": "skipped_nonfinite_loss",
+                            }
+                        )
+                if healthy:
+                    self.save()
 
         return {
             "step": self.global_step,
             "loss": float(loss) if loss is not None else float("nan"),
         }
-
-
-def _replace_like(template: Any, restored: Any) -> Any:
-    """Re-place restored arrays onto the shardings of the template tree."""
-
-    def place(tmpl, arr):
-        if isinstance(tmpl, jax.Array) and arr is not None:
-            return jax.device_put(arr, tmpl.sharding)
-        return arr
-
-    return jax.tree_util.tree_map(place, template, restored)
 
 
 def _from_tree(template: Any, restored: Any) -> Any:
@@ -179,6 +227,10 @@ def _from_tree(template: Any, restored: Any) -> Any:
     """
     if template is None:
         return None
+    if restored is None and not jax.tree_util.tree_leaves(template):
+        # empty containers (optax EmptyState, disabled Kahan tuples)
+        # serialize to None; keep the template's empty structure
+        return template
     if isinstance(template, tuple) and hasattr(template, "_fields"):
         if isinstance(restored, dict):
             missing = [f for f in template._fields if f not in restored]

@@ -42,11 +42,44 @@ def save_checkpoint(path: str, state: Any) -> None:
     _checkpointer().save(os.path.abspath(path), state)
 
 
+def _restore_args_from_template(meta: Any, template: Any):
+    """Build orbax restore_args matching the checkpoint's (plain-nest)
+    metadata tree, taking each leaf's target sharding from ``template``.
+
+    ``template`` carries the live pytree classes (optimizer NamedTuples,
+    dicts, lists) and sharded arrays; ``meta`` is orbax's serialized shape
+    of the same state (NamedTuples as dicts keyed by field name, tuples as
+    dicts keyed by index).  The walk is meta-driven so entries that
+    legitimately vanish in serialization (empty containers) are skipped.
+    """
+    import orbax.checkpoint as ocp
+
+    def walk(m, t):
+        if m is None:  # empty containers (e.g. optax EmptyState) serialize
+            return None  # to None; nothing to restore there
+        if isinstance(t, tuple) and hasattr(t, "_fields"):  # NamedTuple
+            if isinstance(m, dict):
+                return {k: walk(m[k], getattr(t, k)) for k in m}
+            return [walk(mm, tt) for mm, tt in zip(m, t)]
+        if isinstance(t, dict):
+            return {k: walk(m[k], t[k]) for k in m}
+        if isinstance(t, (list, tuple)):
+            if isinstance(m, dict):
+                return {k: walk(m[k], t[int(k)]) for k in m}
+            return [walk(mm, tt) for mm, tt in zip(m, t)]
+        if isinstance(t, jax.Array):
+            return ocp.ArrayRestoreArgs(sharding=t.sharding)
+        return ocp.RestoreArgs()
+
+    return walk(meta, template)
+
+
 def restore_checkpoint(
     path: str,
     *,
     like: Any = None,
     shardings: Any = None,
+    shardings_from: Any = None,
 ) -> Any:
     """Restore a checkpoint.
 
@@ -59,12 +92,23 @@ def restore_checkpoint(
         single Sharding applied to every leaf) of target placements — the
         ``map_location`` analog.  Leaves restore directly into these
         shardings.
+      shardings_from: optional live state pytree (params / optimizer state
+        with their current shardings) used as the placement template:
+        every restored array streams straight into the corresponding
+        template leaf's sharding, with no replicated host copy in between
+        — the streaming form of ``map_location`` for sharded resume.
     """
     import orbax.checkpoint as ocp
 
+    if shardings is not None and shardings_from is not None:
+        raise ValueError("pass either shardings or shardings_from, not both")
     path = os.path.abspath(path)
     ckptr = _checkpointer()
-    if shardings is None:
+    if shardings_from is not None:
+        meta = ckptr.metadata(path).item_metadata.tree
+        restore_args = _restore_args_from_template(meta, shardings_from)
+        out = ckptr.restore(path, restore_args=restore_args)
+    elif shardings is None:
         # `like` alone needs no restore_args (or metadata read) — it only
         # post-validates/casts below
         out = ckptr.restore(path)

@@ -1,0 +1,250 @@
+"""Failure detection and elastic recovery for training loops.
+
+The reference has NO failure-detection subsystem (SURVEY §5.3: absent; the
+only resilience-adjacent logic is GossipGraD's INVALID_PEER skip, which
+parallel/gossip_grad.py preserves).  A TPU framework running long jobs
+still needs the host-side half of elasticity, so this module provides it
+TPU-natively, in three honest layers:
+
+  - **In-step protection** — :func:`guard_nonfinite_updates` wraps the
+    optimizer in ``optax.apply_if_finite``: a step whose gradients contain
+    non-finite values applies NO update at all.  This is the only layer
+    that can truly *skip* a poisoned update, because it runs before the
+    parameters are overwritten.
+  - **Run-level detection** — :class:`FailureDetector`: non-finite-loss
+    detection with a bounded tolerance, and an *overdue-step* check that
+    flags synchronization windows exceeding a wall-clock budget.  Both are
+    post-hoc by construction: a Python process cannot interrupt a blocked
+    XLA call, so a truly hung device is detectable in-process only after
+    it unblocks.  For hard hangs, use the heartbeat below.
+  - **External supervision** — :class:`Heartbeat`: a daemon thread that
+    stamps a file every interval; an external supervisor (or a second
+    process) declares the job dead when the stamp goes stale — the
+    standard elastic-training liveness contract, and the only mechanism
+    that survives a wedged runtime.
+
+Trainer policies (``on_failure``): ``"raise"`` stops the run,
+``"restore"`` rolls back to the latest *health-gated* checkpoint and
+continues, ``"continue"`` only logs (observability; the parameters keep
+whatever the step wrote — pair with :func:`guard_nonfinite_updates` if the
+update itself must be suppressed).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = [
+    "FailureDetector",
+    "StepFailure",
+    "guard_nonfinite_updates",
+    "Heartbeat",
+]
+
+
+def guard_nonfinite_updates(optimizer, max_consecutive_errors: int = 5):
+    """Wrap an optax transformation so steps with non-finite gradients
+    apply no update (the true in-step "skip").  After
+    ``max_consecutive_errors`` consecutive bad steps the wrapper stops
+    masking and lets the update through, surfacing the failure to the
+    run-level detector instead of hiding it forever."""
+    import optax
+
+    return optax.apply_if_finite(optimizer, max_consecutive_errors)
+
+
+class StepFailure(RuntimeError):
+    """A training step failed in a way the failure policy must handle."""
+
+    def __init__(self, kind: str, message: str) -> None:
+        super().__init__(message)
+        self.kind = kind  # "nonfinite" | "deadline"
+
+
+class FailureDetector:
+    """Detects failed steps from the host side.
+
+    Args:
+      nan_tolerance: consecutive non-finite losses tolerated before the
+        step is declared failed (0 = fail on the first).
+      step_deadline_s: wall-clock budget PER STEP for a synchronization
+        window; a window whose average exceeds it is declared overdue.
+        Post-hoc by nature (see module docstring); ``None`` disables it.
+    """
+
+    def __init__(
+        self,
+        *,
+        nan_tolerance: int = 0,
+        step_deadline_s: Optional[float] = None,
+    ) -> None:
+        self.nan_tolerance = nan_tolerance
+        self.step_deadline_s = step_deadline_s
+        self._consecutive_nonfinite = 0
+        self.failures: list[dict] = []  # observability: what happened when
+
+    def reset(self) -> None:
+        """Forget transient state after a failure has been HANDLED, so the
+        configured tolerance applies afresh to the recovered run."""
+        self._consecutive_nonfinite = 0
+
+    # -- loss health -------------------------------------------------------
+
+    def check_loss(self, step: int, loss: float) -> None:
+        """Record ``loss``; raise :class:`StepFailure` when the run is no
+        longer healthy."""
+        if math.isfinite(loss):
+            self._consecutive_nonfinite = 0
+            return
+        self._consecutive_nonfinite += 1
+        self.failures.append(
+            {"step": step, "kind": "nonfinite", "loss": repr(loss)}
+        )
+        if self._consecutive_nonfinite > self.nan_tolerance:
+            raise StepFailure(
+                "nonfinite",
+                f"step {step}: loss is {loss!r} "
+                f"({self._consecutive_nonfinite} consecutive non-finite "
+                f"losses, tolerance {self.nan_tolerance})",
+            )
+
+    # -- overdue-step check ------------------------------------------------
+
+    def check_window(self, step: int, elapsed_s: float, n_steps: int) -> None:
+        """Check a synchronized window of ``n_steps`` against the per-step
+        deadline.  Raises :class:`StepFailure` when overdue."""
+        if self.step_deadline_s is None or n_steps <= 0:
+            return
+        budget = self.step_deadline_s * n_steps
+        if elapsed_s > budget:
+            self.failures.append(
+                {
+                    "step": step,
+                    "kind": "deadline",
+                    "elapsed_s": round(elapsed_s, 3),
+                    "budget_s": round(budget, 3),
+                }
+            )
+            raise StepFailure(
+                "deadline",
+                f"step {step}: {n_steps}-step window took {elapsed_s:.1f}s, "
+                f"over the {budget:.1f}s budget "
+                f"({self.step_deadline_s:.1f}s/step) — device overloaded or "
+                "collective degraded",
+            )
+
+    def deadline(self, n_steps: int = 1) -> "_Deadline":
+        """Context manager form of :meth:`check_window` for standalone
+        loops."""
+        return _Deadline(self, n_steps)
+
+
+class _Deadline:
+    def __init__(self, det: FailureDetector, n_steps: int) -> None:
+        self._det = det
+        self._n = n_steps
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Deadline":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        self._det.check_window(-1, time.monotonic() - self._t0, self._n)
+
+
+class Heartbeat:
+    """Liveness stamp for external supervision of hard hangs.
+
+    A daemon thread writes ``<monotonic-ish unix time> <step>`` to
+    ``path`` every ``interval_s``.  An external supervisor declares the
+    job dead when the file's stamp is older than its own threshold — the
+    only detection that works when the runtime itself is wedged (an
+    in-process watchdog cannot interrupt a blocked XLA call).
+
+    Use as a context manager around ``fit`` (or call :meth:`start` /
+    :meth:`stop`); update ``self.step`` from the training loop for
+    step-resolution liveness.
+    """
+
+    def __init__(self, path: str, interval_s: float = 10.0) -> None:
+        self.path = path
+        self.interval_s = interval_s
+        self.step = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _beat(self) -> None:
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{time.time()} {self.step}\n")
+        os.replace(tmp, self.path)  # atomic: supervisors never read a torn file
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._beat()
+
+    def start(self) -> "Heartbeat":
+        self._beat()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @staticmethod
+    def is_stale(path: str, max_age_s: float) -> bool:
+        """Supervisor-side check: True when the stamp is missing or older
+        than ``max_age_s``."""
+        try:
+            with open(path) as f:
+                stamp = float(f.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            return True
+        return time.time() - stamp > max_age_s
+
+
+def apply_failure_policy(
+    trainer: Any, failure: StepFailure, policy: str
+) -> str:
+    """Resolve a step failure for a Trainer.
+
+    Returns the action taken: "raise" never returns, "continue" keeps
+    current state (log-only), "restore" rolled back to the latest
+    health-gated checkpoint.  Handled failures reset the detector's
+    transient counters so its tolerance applies afresh.
+    """
+    if policy == "raise":
+        raise failure
+    det = getattr(trainer, "failure_detector", None)
+    if policy in ("continue", "skip"):  # "skip" kept as a legacy alias
+        if det is not None:
+            det.reset()
+        return "continued"
+    if policy == "restore":
+        if not getattr(trainer, "_last_checkpoint", None):
+            raise StepFailure(
+                failure.kind,
+                f"{failure} (and no checkpoint exists to restore from)",
+            )
+        trainer.restore(trainer._last_checkpoint)
+        if det is not None:
+            det.reset()
+        return "restored"
+    raise ValueError(f"unknown failure policy {policy!r}")
