@@ -263,3 +263,123 @@ class TestRecordTimeSafety:
         out = tdx.materialize_tensor(fake)
         assert out.dtype == jnp.float64
         np.testing.assert_array_equal(np.asarray(out), eager)
+
+
+class TestChunkedReplay:
+    """replay_mode='chunked': jitted chunk execution must match eager
+    replay up to XLA fusion reassociation (~1 ulp — bit-identity is an
+    eager-mode guarantee only), and structurally repeated layers must
+    share compiled chunks."""
+
+    def _materialize(self, mode, chunk_size=48, n_layers=None):
+        from torchdistx_tpu._graph import RecordingSession
+
+        old_mode, old_cs = RecordingSession.replay_mode, RecordingSession.chunk_size
+        RecordingSession.replay_mode = mode
+        RecordingSession.chunk_size = chunk_size
+        try:
+            from torchdistx_tpu.models import Llama
+
+            kw = {"n_layers": n_layers} if n_layers else {}
+            tdx.manual_seed(42)
+            m = tdx.deferred_init(Llama.from_name, "tiny", **kw)
+            session = next(iter(
+                p for _, p in m.named_parameters()
+            ))._session
+            tdx.materialize_module(m)
+            params = {k: np.asarray(v) for k, v in m.named_parameters()}
+            return params, session
+        finally:
+            RecordingSession.replay_mode = old_mode
+            RecordingSession.chunk_size = old_cs
+
+    def test_chunked_matches_eager(self):
+        eager, _ = self._materialize("eager")
+        chunked, session = self._materialize("chunked", chunk_size=16)
+        assert eager.keys() == chunked.keys()
+        for k in eager:
+            np.testing.assert_allclose(
+                eager[k], chunked[k], rtol=2e-6, atol=1e-8, err_msg=k
+            )
+
+    def test_period_aligned_chunks_share_compiles(self):
+        # 6 identical layers: period-aligned chunking must give far fewer
+        # unique compiled chunks than dispatched chunks
+        _, session = self._materialize("chunked", chunk_size=8, n_layers=6)
+        assert session.chunk_dispatches > 0
+        assert session.chunk_compiles < session.chunk_dispatches / 2, (
+            session.chunk_compiles,
+            session.chunk_dispatches,
+        )
+        # executors are dropped once the graph is fully materialized
+        assert session._chunk_cache == {}
+
+    def test_chunk_bounds_cover_everything(self):
+        from torchdistx_tpu._graph import _chunk_bounds
+
+        def check(names, cs):
+            bounds = _chunk_bounds(names, cs)
+            covered = [i for a, b in bounds for i in range(a, b)]
+            assert covered == list(range(len(names))), (bounds, len(names))
+            assert all(b > a for a, b in bounds)
+
+        # review repro: prologue (3) not a multiple of chunk_size (8),
+        # period 10 — ops [3, 8) must not be skipped
+        names = ["emb"] * 3 + ["a", "b", "c", "d", "e", "f", "g", "h", "i", "j"] * 6 + ["norm"]
+        check(names, 8)
+        # short period (2) smaller than chunk_size: grouped chunks
+        names2 = ["w", "b"] * 40
+        check(names2, 16)
+        bounds2 = _chunk_bounds(names2, 16)
+        assert max(b - a for a, b in bounds2) == 16  # grouping happened
+        # no period at all
+        check([f"op{i}" for i in range(37)], 8)
+        # degenerate sizes
+        check(["x"] * 5, 8)
+        check([], 8)
+
+    def test_chunked_sharded_targets(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from torchdistx_tpu._graph import RecordingSession
+
+        old = RecordingSession.replay_mode
+        RecordingSession.replay_mode = "chunked"
+        try:
+            tdx.manual_seed(3)
+            m = tdx.deferred_init(MLP)
+            tdx.materialize_module(
+                m,
+                sharding_rule=lambda path, fake: NamedSharding(mesh8, P())
+                if fake.ndim < 2
+                else NamedSharding(mesh8, P("fsdp")),
+            )
+            w = dict(m.named_parameters())["fc1.weight"]
+            assert len(w.sharding.device_set) == 8
+        finally:
+            RecordingSession.replay_mode = old
+        # same seed, eager, single device: same values up to the chunked
+        # mode's ~1-ulp fusion tolerance
+        tdx.manual_seed(3)
+        m2 = tdx.deferred_init(MLP)
+        tdx.materialize_module(m2)
+        np.testing.assert_allclose(
+            np.asarray(w),
+            np.asarray(dict(m2.named_parameters())["fc1.weight"]),
+            rtol=2e-6,
+            atol=1e-8,
+        )
+
+    def test_chunked_partial_then_rest(self):
+        from torchdistx_tpu._graph import RecordingSession
+
+        old = RecordingSession.replay_mode
+        RecordingSession.replay_mode = "chunked"
+        try:
+            tdx.manual_seed(4)
+            m = tdx.deferred_init(MLP)
+            # materialize one tensor first (partial), then the module
+            w = tdx.materialize_tensor(dict(m.named_parameters())["fc2.weight"])
+            tdx.materialize_module(m)
+            assert dict(m.named_parameters())["fc2.weight"] is w
+        finally:
+            RecordingSession.replay_mode = old

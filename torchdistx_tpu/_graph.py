@@ -144,7 +144,11 @@ class OpClosure:
     out_treedef: Any  # treedef to unflatten fn's output
     tls: Optional[dict[str, Any]] = None  # captured jax config context
 
-    def call(self, env: dict[tuple[int, int], Any]) -> list[Any]:
+    def call(
+        self,
+        env: dict[tuple[int, int], Any],
+        ambient: Optional[dict[str, Any]] = None,
+    ) -> list[Any]:
         def resolve(x: Any) -> Any:
             if isinstance(x, NodeRef):
                 return env[(x.node, x.out_idx)]
@@ -159,12 +163,16 @@ class OpClosure:
         kwargs = jax.tree_util.tree_map(
             resolve, self.kwargs, is_leaf=is_placeholder
         )
-        out = self._run(args, kwargs)
+        out = self._run(args, kwargs, ambient)
         leaves = jax.tree_util.tree_leaves(out)
         return leaves
 
-    def _run(self, args, kwargs):
-        if not self.tls:
+    def _run(self, args, kwargs, ambient: Optional[dict[str, Any]] = None):
+        # fast path: jax.config attribute reads are not free, and a replay
+        # executes thousands of closures — when the caller has already
+        # captured the ambient config once (capture_context()), an
+        # equality check replaces three per-op config round-trips
+        if not self.tls or (ambient is not None and ambient == self.tls):
             return self.fn(*args, **kwargs)
         saved = {}
         try:
@@ -186,7 +194,26 @@ class RecordingSession:
     Thread-safety follows the reference's model: mode state is thread-local
     (reference fake.cc:554,588) but a session's graph is shared, so closure
     and cache maps are guarded by a lock.
+
+    ``replay_mode`` selects the executor:
+      - "eager" (default): op-by-op on-device execution.  JAX's eager
+        primitive cache gives each repeated (op, shape) one compilation;
+        measured 7-10x faster end-to-end than one whole-model jit, whose
+        XLA compile time scales with the giant replay graph.
+      - "chunked": the schedule is cut into fixed-size chunks, each traced
+        and jitted as one function, with the jit cache keyed by the
+        chunk's (op names, external aval) signature — structurally
+        repeated layers share one compile.  Each chunk is ONE dispatch
+        instead of chunk_size round-trips, which matters when dispatch
+        rides a network relay to the device.  XLA fusion inside a chunk
+        may reassociate float math: chunked materialization matches eager
+        init to ~1 ulp, not bit-for-bit (eager mode keeps bit-identity).
+    Class attributes so benchmarks can flip globally; per-instance
+    override allowed.
     """
+
+    replay_mode: str = "eager"
+    chunk_size: int = 48
 
     def __init__(self) -> None:
         self.graph = NativeGraph()
@@ -197,6 +224,14 @@ class RecordingSession:
         # node -> number of live FakeArray handles (mirrors native pins so the
         # replay executor knows which outputs must survive the fused jit call)
         self.pins: dict[int, int] = {}
+        # chunked-replay jit cache: signature -> compiled chunk executor
+        self._chunk_cache: dict[Any, Any] = {}
+        # schedule-names hash -> (period, start), so repeated replays of
+        # the same session don't re-run period detection
+        self._period_cache: dict[Any, Any] = {}
+        # observability: compiles vs dispatches (survive cache clearing)
+        self.chunk_compiles = 0
+        self.chunk_dispatches = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -357,9 +392,9 @@ class RecordingSession:
                     ]
 
         env: dict[tuple[int, int], Any] = dict(ext_inputs)
-        for nid in sched:
-            closure = self.closures[nid]
-            outs = closure.call(env)
+        ambient = capture_context()
+
+        def emit(nid, outs):
             for i, o in enumerate(outs):
                 key = (nid, i)
                 sharding = resolved_targets.get(key)
@@ -369,7 +404,7 @@ class RecordingSession:
                 if key in keep:
                     self.cache[key] = o
             # release producers whose last in-schedule consumer just ran
-            for arg in _iter_noderefs(closure):
+            for arg in _iter_noderefs(self.closures[nid]):
                 if arg.node in uses:
                     uses[arg.node] -= 1
                     if uses[arg.node] == 0 and not any(
@@ -379,12 +414,164 @@ class RecordingSession:
                         for j in range(self.closures[arg.node].n_outputs):
                             env.pop((arg.node, j), None)
 
+        if self.replay_mode == "chunked":
+            self._replay_chunked(sched, env, emit, ambient)
+        else:
+            for nid in sched:
+                outs = self.closures[nid].call(env, ambient)
+                emit(nid, outs)
+
         for nid in sched:
             released = self.graph.mark_materialized(nid)
             for rid in released:
                 self.closures.pop(rid, None)
                 for k in [k for k in self.cache if k[0] == rid]:
                     del self.cache[k]
+
+        # a fully materialized graph will never replay again: drop the
+        # chunk executors (their traces pin the closure fns they captured)
+        if self.graph.num_materialized() == self.graph.num_nodes():
+            self._chunk_cache.clear()
+            self._period_cache.clear()
+
+    # -- chunked replay ----------------------------------------------------
+
+    def _replay_chunked(self, sched, env, emit, ambient) -> None:
+        """Execute the schedule in jitted chunks aligned to the model's
+        repeating layer structure.
+
+        Each chunk is one compiled executable — one dispatch instead of
+        ``chunk_size`` eager round-trips (decisive when dispatch rides a
+        network relay).  The jit cache is keyed by the chunk's structural
+        signature (op code objects + recursively-hashed static closure
+        cells + argument wiring + external/dynamic avals), so repeated
+        chunks share one compilation.  Sharing only pays off when chunk
+        boundaries land at the same offset of every repeated layer, so the
+        op-name sequence's period is detected and boundaries are cut at
+        ``prologue + k*period (+ j*chunk_size within a long period)``;
+        without a detectable period, fixed-size chunks are used (correct,
+        just compile-heavier).
+        """
+        names = [self.graph.name(n) for n in sched]
+        key = hash(tuple(names))
+        if key not in self._period_cache:
+            self._period_cache[key] = _detect_period(names)
+        bounds = _chunk_bounds(
+            names, self.chunk_size, period_hint=self._period_cache[key]
+        )
+        for a, b in bounds:
+            self._run_chunk(sched[a:b], env, emit, ambient)
+
+    def _run_chunk(self, chunk, env, emit, ambient) -> None:
+        closures = [self.closures[n] for n in chunk]
+
+        # per-op captured config must be uniform and equal to the ambient
+        # for a single jitted chunk; anything else falls back to eager
+        tls_list = [dict(c.tls) if c.tls else None for c in closures]
+        if any(t != tls_list[0] for t in tls_list) or (
+            tls_list[0] is not None and tls_list[0] != ambient
+        ):
+            for nid in chunk:
+                emit(nid, self.closures[nid].call(env, ambient))
+            return
+
+        in_chunk = {n: j for j, n in enumerate(chunk)}
+
+        # discover external NodeRef inputs (ordered, deduped) and dynamic
+        # (array / guarded) leaves per closure, replacing each with a
+        # _Slot placeholder so the plan is value-free
+        ext_keys: list[tuple[int, int]] = []
+        ext_index: dict[tuple[int, int], int] = {}
+        dyn_vals: list[Any] = []
+        plans = []  # per closure: (args, kwargs) with _Slot leaves
+        sig_parts = []
+
+        def plan_leaf(x, sig_acc):
+            if isinstance(x, NodeRef):
+                if x.node in in_chunk:
+                    sig_acc.append(("loc", in_chunk[x.node], x.out_idx))
+                    return _Slot("loc", in_chunk[x.node], x.out_idx)
+                key = (x.node, x.out_idx)
+                if key not in ext_index:
+                    ext_index[key] = len(ext_keys)
+                    ext_keys.append(key)
+                sig_acc.append(("ext", ext_index[key]))
+                return _Slot("ext", ext_index[key])
+            if isinstance(x, GuardedArg):
+                v = x.resolve()  # fingerprint re-verified per run
+                dyn_vals.append(v)
+                sig_acc.append(("dyn", tuple(v.shape), str(v.dtype)))
+                return _Slot("dyn", len(dyn_vals) - 1)
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                dyn_vals.append(x)
+                sig_acc.append(("dyn", tuple(x.shape), str(x.dtype)))
+                return _Slot("dyn", len(dyn_vals) - 1)
+            try:
+                sig_acc.append(("static", _freeze(x)))
+            except TypeError:
+                sig_acc.append(("static-id", id(x)))  # unshareable
+            return _Slot("static", x)
+
+        is_ph = lambda x: isinstance(x, (NodeRef, GuardedArg))  # noqa: E731
+        for c in closures:
+            acc: list = [_callable_sig(c.fn), c.n_outputs]
+            planned_args = jax.tree_util.tree_map(
+                lambda x: plan_leaf(x, acc), c.args, is_leaf=is_ph
+            )
+            planned_kwargs = jax.tree_util.tree_map(
+                lambda x: plan_leaf(x, acc), c.kwargs, is_leaf=is_ph
+            )
+            plans.append((planned_args, planned_kwargs))
+            sig_parts.append(tuple(_freeze(s) for s in acc))
+
+        ext_vals = [env[k] for k in ext_keys]
+        sig = (
+            tuple(sig_parts),
+            tuple((tuple(v.shape), str(v.dtype)) for v in ext_vals),
+            tuple(sorted(tls_list[0].items())) if tls_list[0] else None,
+        )
+
+        self.chunk_dispatches += 1
+        entry = self._chunk_cache.get(sig)
+        if entry is None:
+            self.chunk_compiles += 1
+            # capture only what the trace needs — fns and value-free plans
+            # (GuardedArg values already moved to dyn inputs) — NOT the
+            # OpClosure objects, whose args would pin host buffers in the
+            # cache after graph GC frees the closures themselves
+            fns = [c.fn for c in closures]
+
+            def chunk_fn(ext_in, dyn_in):
+                local: list[list[Any]] = []
+
+                def fill(ph: "_Slot"):
+                    if ph.kind == "loc":
+                        return local[ph.a][ph.b]
+                    if ph.kind == "ext":
+                        return ext_in[ph.a]
+                    if ph.kind == "dyn":
+                        return dyn_in[ph.a]
+                    return ph.a  # static
+
+                is_p = lambda x: isinstance(x, _Slot)  # noqa: E731
+                for fn, (pa, pk) in zip(fns, plans):
+                    args = jax.tree_util.tree_map(fill, pa, is_leaf=is_p)
+                    kwargs = jax.tree_util.tree_map(fill, pk, is_leaf=is_p)
+                    out = fn(*args, **kwargs)
+                    local.append(jax.tree_util.tree_leaves(out))
+                flat: list[Any] = []
+                for outs in local:
+                    flat.extend(outs)
+                return flat
+
+            entry = jax.jit(chunk_fn)
+            self._chunk_cache[sig] = entry
+
+        flat = entry(ext_vals, dyn_vals)
+        pos = 0
+        for nid, c in zip(chunk, closures):
+            emit(nid, flat[pos : pos + c.n_outputs])
+            pos += c.n_outputs
 
     def can_materialize(self, node: int) -> bool:
         with self._lock:
@@ -405,6 +592,138 @@ class RecordingSession:
         round-trip; previously-materialized dependencies are consumed from
         the replay cache rather than recomputed."""
         return self.materialize_many([(node, out_idx)], [sharding], [device])[0]
+
+
+def _detect_period(names: list, max_period: int = 512):
+    """Smallest shift p such that ~90% of the sequence self-matches under
+    it — the op-count of one repeated layer.  Also returns the start of
+    the periodic region (end of the init prologue)."""
+    n = len(names)
+    for p in range(2, min(max_period, n // 2) + 1):
+        allowed_miss = int(0.1 * (n - p))
+        misses = 0
+        for i in range(n - p):
+            if names[i] != names[i + p]:
+                misses += 1
+                if misses > allowed_miss:
+                    break
+        if misses <= allowed_miss:
+            # locate where periodicity begins (skip embedding/prologue ops)
+            start = 0
+            for i in range(n - p):
+                if names[i] != names[i + p]:
+                    start = i + 1
+                else:
+                    # require a full period of matches from here
+                    if all(
+                        names[j] == names[j + p]
+                        for j in range(i, min(i + p, n - p))
+                    ):
+                        break
+            return p, start
+    return None, 0
+
+
+def _chunk_bounds(names: list, chunk_size: int, period_hint=None) -> list:
+    """Chunk boundaries over ``names``: period-aligned when a repeating
+    layer structure is detected, else fixed-size.  Periods shorter than
+    ``chunk_size`` are grouped (still signature-aligned) so the dispatch
+    batching survives fine-grained op patterns."""
+    n = len(names)
+    p, start = period_hint if period_hint is not None else _detect_period(names)
+    bounds = []
+
+    def fixed(a, end):
+        while a < end:
+            bounds.append((a, min(a + chunk_size, end)))
+            a = min(a + chunk_size, end)
+        return a
+
+    if p is None:
+        fixed(0, n)
+        return bounds
+    a = fixed(0, start)  # prologue (ends exactly at `start`)
+    group = max(1, chunk_size // p)  # whole periods per chunk when p small
+
+    def period_matches(at):
+        return at + p <= n and all(
+            names[at + j] == names[start + j] for j in range(p)
+        )
+
+    while period_matches(a):
+        if p >= chunk_size:
+            # cut each period at the same internal offsets, so a chunk at
+            # offset j of layer k shares its signature with layer k+1's
+            for off in range(0, p, chunk_size):
+                bounds.append((a + off, a + min(off + chunk_size, p)))
+            a += p
+        else:
+            run_start = a
+            k = 0
+            while k < group and period_matches(a):
+                a += p
+                k += 1
+            bounds.append((run_start, a))
+    # epilogue
+    fixed(a, n)
+    return bounds
+
+
+@dataclasses.dataclass(frozen=True)
+class _Slot:
+    """Value-free placeholder in a chunk plan: a chunk-local output
+    ("loc", closure_idx, out_idx), an external env input ("ext", idx), a
+    dynamic array input ("dyn", idx), or an inline static ("static",
+    value)."""
+
+    kind: str
+    a: Any = None
+    b: Any = None
+
+
+def _callable_sig(fn: Any, depth: int = 0):
+    """Best-effort structural identity of a (possibly nested) closure:
+    code object + recursively hashed static cell contents.  Arrays or
+    unhashables in cells yield an id()-based token, making the signature
+    unique (no sharing) rather than wrong."""
+    if depth > 4:
+        return ("deep", id(fn))
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # builtins / jnp functions: identity is the function object
+        return ("obj", id(fn))
+    cells = getattr(fn, "__closure__", None) or ()
+    sig = []
+    for cell in cells:
+        try:
+            v = cell.cell_contents
+        except ValueError:  # empty cell
+            sig.append(("empty",))
+            continue
+        if callable(v) and not isinstance(v, type):
+            sig.append(_callable_sig(v, depth + 1))
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            sig.append(("arr-id", id(v)))  # value-bearing: unshareable
+        else:
+            try:
+                hash(v)
+                sig.append(("val", v))
+            except TypeError:
+                try:
+                    sig.append(("val-frozen", _freeze(v)))
+                except Exception:
+                    sig.append(("val-id", id(v)))
+    return ("code", code, tuple(sig))
+
+
+def _freeze(x: Any):
+    """Hashable view of nested lists/tuples/dicts of hashables."""
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(v) for v in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in x.items()))
+    hash(x)
+    return x
 
 
 def _iter_noderefs(closure: OpClosure):
