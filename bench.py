@@ -20,13 +20,27 @@ from __future__ import annotations
 
 import functools
 import json
+import math
 import resource
 import time
 
 V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
 
 
+def _set_platform():
+    # smoke-testing hook: the axon sitecustomize pins JAX_PLATFORMS, so a
+    # CPU run must override via jax.config BEFORE the first device use
+    import os
+
+    p = os.environ.get("TDX_BENCH_PLATFORM")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
 def _train_throughput():
+    _set_platform()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -37,8 +51,10 @@ def _train_throughput():
     from torchdistx_tpu.nn.module import functional_call
     from torchdistx_tpu.optimizers import anyprecision_adamw
 
-    name = "llama_1b"
-    batch, seq = 2, 2048
+    import os
+
+    name = os.environ.get("TDX_BENCH_TRAIN_MODEL", "llama_1b")
+    batch, seq = 2, int(os.environ.get("TDX_BENCH_SEQ", "2048"))
     tdx.manual_seed(0)
     model = tdx.deferred_init(Llama.from_name, name, max_seq_len=seq)
     tdx.materialize_module(model)
@@ -71,9 +87,10 @@ def _train_throughput():
     def run(carry):
         return lax.scan(step, carry, None, length=n_steps)
 
+    vocab = llama_configs[name].get("vocab_size", 32000)
     rs = np.random.RandomState(0)
-    tokens = jnp.asarray(rs.randint(0, 32000, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(rs.randint(0, 32000, (batch, seq)), jnp.int32)
+    tokens = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
 
     # warm (compile) + sync via host fetch (relay-proof)
     (params, opt_state), losses = run((params, opt_state))
@@ -98,7 +115,9 @@ def _train_throughput():
         "train_seq": seq,
         "train_steps_timed": n_steps,
         "train_window_s": round(dt, 3),
-        "train_final_loss": round(final_loss, 4),
+        "train_final_loss": round(final_loss, 4)
+        if math.isfinite(final_loss)
+        else None,
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),
         "flash_attention": True,
@@ -106,31 +125,21 @@ def _train_throughput():
     }
 
 
-def main() -> None:
-    import subprocess
-    import sys
-
+def _materialize_7b(replay_mode: str) -> dict:
+    _set_platform()
     import jax
 
     import torchdistx_tpu as tdx
+    from torchdistx_tpu._graph import RecordingSession
     from torchdistx_tpu.models import Llama
 
-    # Phase 2 runs FIRST, in its own process: both phases nearly fill the
-    # 16 GB chip, so each needs a fresh HBM arena.
-    proc = subprocess.run(
-        [sys.executable, __file__, "--train-phase"],
-        capture_output=True,
-        text=True,
-    )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"training-throughput phase failed:\n{proc.stdout}\n{proc.stderr}"
-        )
-    train = json.loads(proc.stdout.strip().splitlines()[-1])
+    import os
 
+    RecordingSession.replay_mode = replay_mode
+    bench_model = os.environ.get("TDX_BENCH_MODEL", "llama2_7b")  # tiny for smoke tests
     t0 = time.time()
     tdx.manual_seed(0)
-    model = tdx.deferred_init(Llama.from_name, "llama2_7b")
+    model = tdx.deferred_init(Llama.from_name, bench_model)
     t_defer = time.time() - t0
     n_params = model.num_params()
 
@@ -138,9 +147,51 @@ def main() -> None:
     tdx.materialize_module(model)
     jax.block_until_ready([p for _, p in model.named_parameters()])
     t_mat = time.time() - t0
+    return {
+        "replay_mode": replay_mode,
+        "deferred_init_s": round(t_defer, 3),
+        "materialize_s": round(t_mat, 3),
+        "total_s": round(t_defer + t_mat, 3),
+        "params": int(n_params),
+        "peak_host_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 3
+        ),
+        "device": str(jax.devices()[0]),
+    }
 
-    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
-    total = t_defer + t_mat
+
+def _run_phase(arg: str) -> dict:
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, __file__, arg],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"phase {arg} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    # Every phase runs in its own process: each nearly fills the 16 GB
+    # chip and needs a fresh HBM arena.
+    train = _run_phase("--train-phase")
+    eager = _run_phase("--materialize-phase=eager")
+    # A/B: chunked replay batches dispatches (one per compiled chunk) —
+    # measured alongside the default so the trade is always on record
+    try:
+        chunked = _run_phase("--materialize-phase=chunked")
+    except RuntimeError as e:  # never lose the primary metric to the A/B
+        chunked = {"error": str(e)[-500:]}
+
+    total = eager["total_s"]
+    t_defer, t_mat = eager["deferred_init_s"], eager["materialize_s"]
+    n_params = eager["params"]
+    peak_rss_gb = eager["peak_host_rss_gb"]
 
     print(
         json.dumps(
@@ -152,12 +203,13 @@ def main() -> None:
                 "tokens_per_sec": train.pop("tokens_per_sec"),
                 "mfu": train.pop("mfu"),
                 "extra": {
-                    "deferred_init_s": round(t_defer, 3),
-                    "materialize_s": round(t_mat, 3),
-                    "params": int(n_params),
-                    "peak_host_rss_gb": round(peak_rss_gb, 3),
+                    "deferred_init_s": t_defer,
+                    "materialize_s": t_mat,
+                    "params": n_params,
+                    "peak_host_rss_gb": peak_rss_gb,
                     "north_star": "<60s, <32GB host RAM (BASELINE.json cfg 5)",
-                    "device": str(jax.devices()[0]),
+                    "device": eager["device"],
+                    "materialize_chunked": chunked,
                     **train,
                 },
             }
@@ -170,5 +222,12 @@ if __name__ == "__main__":
 
     if "--train-phase" in sys.argv:
         print(json.dumps(_train_throughput()))
+    elif any(a.startswith("--materialize-phase=") for a in sys.argv):
+        mode = next(
+            a.split("=", 1)[1]
+            for a in sys.argv
+            if a.startswith("--materialize-phase=")
+        )
+        print(json.dumps(_materialize_7b(mode)))
     else:
         main()

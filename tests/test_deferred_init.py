@@ -383,3 +383,33 @@ class TestChunkedReplay:
             assert dict(m.named_parameters())["fc2.weight"] is w
         finally:
             RecordingSession.replay_mode = old
+
+    def test_signature_distinguishes_defaults_and_bound_methods(self):
+        from torchdistx_tpu._graph import _callable_sig
+
+        f1 = eval("lambda x, scale=1.0: x * scale")
+        f2 = eval("lambda x, scale=2.0: x * scale")
+        assert _callable_sig(f1) != _callable_sig(f2)
+
+        class Cfg:
+            def __init__(self, s):
+                self.s = s
+
+            def init(self, x):
+                return x * self.s
+
+        a, b = Cfg(1.0), Cfg(2.0)
+        assert _callable_sig(a.init) != _callable_sig(b.init)
+        assert _callable_sig(a.init) == _callable_sig(a.init)
+
+    def test_unknown_replay_mode_rejected(self):
+        from torchdistx_tpu._graph import RecordingSession
+
+        old = RecordingSession.replay_mode
+        RecordingSession.replay_mode = "chunkd"  # typo'd mode must not
+        try:                                      # silently run eager
+            m = tdx.deferred_init(lambda: nn.Linear(2, 2))
+            with pytest.raises(ValueError, match="unknown replay_mode"):
+                tdx.materialize_module(m)
+        finally:
+            RecordingSession.replay_mode = old

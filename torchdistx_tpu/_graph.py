@@ -143,6 +143,13 @@ class OpClosure:
     n_outputs: int  # flattened output count
     out_treedef: Any  # treedef to unflatten fn's output
     tls: Optional[dict[str, Any]] = None  # captured jax config context
+    _fn_sig: Any = None  # memoized _callable_sig (immutable per closure)
+
+    @property
+    def fn_sig(self) -> Any:
+        if self._fn_sig is None:
+            self._fn_sig = _callable_sig(self.fn)
+        return self._fn_sig
 
     def call(
         self,
@@ -414,6 +421,11 @@ class RecordingSession:
                         for j in range(self.closures[arg.node].n_outputs):
                             env.pop((arg.node, j), None)
 
+        if self.replay_mode not in ("eager", "chunked"):
+            raise ValueError(
+                f"unknown replay_mode {self.replay_mode!r} "
+                "(expected 'eager' or 'chunked')"
+            )
         if self.replay_mode == "chunked":
             self._replay_chunked(sched, env, emit, ambient)
         else:
@@ -514,7 +526,7 @@ class RecordingSession:
 
         is_ph = lambda x: isinstance(x, (NodeRef, GuardedArg))  # noqa: E731
         for c in closures:
-            acc: list = [_callable_sig(c.fn), c.n_outputs]
+            acc: list = [c.fn_sig, c.n_outputs]
             planned_args = jax.tree_util.tree_map(
                 lambda x: plan_leaf(x, acc), c.args, is_leaf=is_ph
             )
@@ -681,39 +693,61 @@ class _Slot:
     b: Any = None
 
 
+def _value_sig(v: Any, depth: int):
+    """Signature of one captured value (closure cell or default arg)."""
+    if callable(v) and not isinstance(v, type):
+        return _callable_sig(v, depth + 1)
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return ("arr-id", id(v))  # value-bearing: unshareable
+    try:
+        hash(v)
+        return ("val", v)
+    except TypeError:
+        try:
+            return ("val-frozen", _freeze(v))
+        except Exception:
+            return ("val-id", id(v))
+
+
 def _callable_sig(fn: Any, depth: int = 0):
     """Best-effort structural identity of a (possibly nested) closure:
-    code object + recursively hashed static cell contents.  Arrays or
-    unhashables in cells yield an id()-based token, making the signature
-    unique (no sharing) rather than wrong."""
+    code object + recursively hashed static cell contents + default
+    arguments + bound receiver.  Arrays, unhashables, and bound ``self``
+    objects yield an id()-based token, making the signature unique (no
+    sharing) rather than wrong."""
     if depth > 4:
         return ("deep", id(fn))
+    # bound methods: receiver state can differ per layer — unshareable by
+    # identity, with the underlying function still structurally keyed
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is not None:
+        return (
+            "bound",
+            id(self_obj),
+            _callable_sig(fn.__func__, depth + 1),
+        )
     code = getattr(fn, "__code__", None)
     if code is None:
         # builtins / jnp functions: identity is the function object
         return ("obj", id(fn))
-    cells = getattr(fn, "__closure__", None) or ()
     sig = []
-    for cell in cells:
+    for cell in getattr(fn, "__closure__", None) or ():
         try:
             v = cell.cell_contents
         except ValueError:  # empty cell
             sig.append(("empty",))
             continue
-        if callable(v) and not isinstance(v, type):
-            sig.append(_callable_sig(v, depth + 1))
-        elif hasattr(v, "shape") and hasattr(v, "dtype"):
-            sig.append(("arr-id", id(v)))  # value-bearing: unshareable
-        else:
-            try:
-                hash(v)
-                sig.append(("val", v))
-            except TypeError:
-                try:
-                    sig.append(("val-frozen", _freeze(v)))
-                except Exception:
-                    sig.append(("val-id", id(v)))
-    return ("code", code, tuple(sig))
+        sig.append(_value_sig(v, depth))
+    # late-binding idiom `lambda x, scale=s: ...` stores s in __defaults__,
+    # not in a cell — it must key the signature too
+    defaults = tuple(
+        _value_sig(v, depth) for v in getattr(fn, "__defaults__", None) or ()
+    )
+    kwdefaults = tuple(
+        (k, _value_sig(v, depth))
+        for k, v in sorted((getattr(fn, "__kwdefaults__", None) or {}).items())
+    )
+    return ("code", code, tuple(sig), defaults, kwdefaults)
 
 
 def _freeze(x: Any):
