@@ -3,10 +3,12 @@ from .torch_interop import (
     gpt2_key_map,
     llama_key_map,
     t5_key_map,
+    to_torch_state_dict,
 )
 
 __all__ = [
     "from_torch_state_dict",
+    "to_torch_state_dict",
     "gpt2_key_map",
     "llama_key_map",
     "t5_key_map",

@@ -21,6 +21,7 @@ import numpy as np
 
 __all__ = [
     "from_torch_state_dict",
+    "to_torch_state_dict",
     "gpt2_key_map",
     "llama_key_map",
     "t5_key_map",
@@ -87,6 +88,40 @@ def from_torch_state_dict(
         module._set_by_path(ours, value)
         del arr
     return module
+
+
+def to_torch_state_dict(
+    module: Any,
+    key_map: KeyMap,
+    *,
+    as_torch: bool = False,
+) -> dict[str, Any]:
+    """Export ``module``'s weights under the torch/HF naming — the inverse
+    of :func:`from_torch_state_dict`, using the same key maps.
+
+    The transforms in the maps are all transposes (HF Conv1D layout) or
+    identity, both self-inverse, so the map runs backwards directly.
+    Tensors stream one at a time (sharded arrays gather per tensor, not
+    per model).  With ``as_torch=True`` values are ``torch.Tensor``
+    (requires torch); otherwise numpy.
+    """
+    own = dict(module.state_dict())
+    missing = [k for k in key_map if k not in own]
+    if missing:
+        raise KeyError(f"key_map sources not in module: {missing[:5]}")
+    out: dict[str, Any] = {}
+    for ours, (theirs, transform) in key_map.items():
+        arr = np.asarray(own[ours])
+        if transform is not None:
+            # identity or transpose — self-inverse either way
+            arr = transform(arr)
+        if as_torch:
+            import torch
+
+            out[theirs] = torch.from_numpy(np.ascontiguousarray(arr))
+        else:
+            out[theirs] = arr
+    return out
 
 
 def gpt2_key_map(n_layers: int) -> KeyMap:
