@@ -118,7 +118,9 @@ def to_torch_state_dict(
         if as_torch:
             import torch
 
-            out[theirs] = torch.from_numpy(np.ascontiguousarray(arr))
+            # copy: jax-backed numpy views are read-only, and torch warns
+            # (and UBs on write) for non-writable sources
+            out[theirs] = torch.from_numpy(np.array(arr, copy=True))
         else:
             out[theirs] = arr
     return out
