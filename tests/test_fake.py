@@ -185,3 +185,74 @@ class TestCatchAllInterception:
             f = jnp.ones((2, 5))
             assert jnp.shape(f) == (2, 5)
             assert jnp.ndim(f) == 2
+
+
+class TestNoDeferredInit:
+    """no_deferred_init(): the reference's NoDeferredInit guard
+    (deferred_init.h:35-37) as public API — ops inside a suspended section
+    run for real and are not recorded."""
+
+    def test_real_compute_inside_deferred(self):
+        captured = {}
+
+        def build():
+            from torchdistx_tpu import nn
+
+            with tdx.no_deferred_init():
+                table = jnp.arange(4.0) * 2  # concrete, unrecorded
+                captured["table"] = table
+            lin = nn.Linear(4, int(table[3]))  # value usable for shapes
+            return lin
+
+        m = tdx.deferred_init(build)
+        assert isinstance(captured["table"], jax.Array)
+        assert m._parameters["weight"].shape == (6, 4)
+        assert tdx.is_fake(m._parameters["weight"])  # recording resumed
+        tdx.materialize_module(m)
+        assert m._parameters["weight"].shape == (6, 4)
+
+    def test_suspends_plain_fake_mode_too(self):
+        with tdx.fake_mode():
+            with tdx.no_deferred_init():
+                r = jnp.zeros((3,))
+                assert isinstance(r, jax.Array)
+            f = jnp.zeros((3,))
+            assert tdx.is_fake(f)
+
+    def test_restores_after_exception(self):
+        def build():
+            from torchdistx_tpu import nn
+
+            try:
+                with tdx.no_deferred_init():
+                    raise RuntimeError("boom")
+            except RuntimeError:
+                pass
+            return nn.Linear(2, 2)
+
+        m = tdx.deferred_init(build)
+        assert tdx.is_fake(m._parameters["weight"])
+
+    def test_fake_args_stay_fake_inside_guard(self):
+        # parity: the reference's NoDeferredInit clears only the mode key;
+        # ops on fake tensor args still dispatch through the Fake handler
+        # (a fake has no data to compute with)
+        def build():
+            from torchdistx_tpu import nn
+
+            lin = nn.Linear(2, 2)
+            with tdx.no_deferred_init():
+                doubled = lin._parameters["weight"] * 2
+            lin.register_parameter("wx2", doubled)
+            return lin
+
+        import numpy as np
+
+        m = tdx.deferred_init(build)
+        assert tdx.is_fake(m._parameters["wx2"])
+        tdx.materialize_module(m)
+        np.testing.assert_allclose(
+            np.asarray(m._parameters["wx2"]),
+            np.asarray(m._parameters["weight"]) * 2,
+            rtol=1e-6,
+        )

@@ -22,7 +22,14 @@ from .deferred_init import (
     materialize_module,
     materialize_tensor,
 )
-from .fake import FakeArray, FakeDevice, fake_mode, is_fake, meta_like
+from .fake import (
+    FakeArray,
+    FakeDevice,
+    fake_mode,
+    is_fake,
+    meta_like,
+    no_deferred_init,
+)
 from .utils.rng import manual_seed, next_rng_key, rng_scope
 
 __all__ = [
@@ -31,6 +38,7 @@ __all__ = [
     "ops",
     "generate",
     "fake_mode",
+    "no_deferred_init",
     "is_fake",
     "meta_like",
     "FakeArray",

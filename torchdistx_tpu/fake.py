@@ -27,6 +27,7 @@ __all__ = [
     "FakeArray",
     "FakeDevice",
     "fake_mode",
+    "no_deferred_init",
     "is_fake",
     "meta_like",
     "current_session",
@@ -123,6 +124,27 @@ def _enter_deferred(session: Any) -> None:
 def _leave_deferred() -> None:
     _tls.session = None
     _tls.fake_level -= 1
+
+
+@contextlib.contextmanager
+def no_deferred_init():
+    """Temporarily suspend the fake/deferred MODE: creation ops and ops on
+    real arrays inside execute for real and are not recorded.
+
+    Ops whose arguments are existing fake arrays necessarily stay fake —
+    a fake has no data to compute with — exactly as in the reference,
+    where its ``NoDeferredInit`` RAII guard (reference
+    src/cc/torchdistx/deferred_init.h:35-37) clears only the DeferredInit
+    key while fake tensor arguments still dispatch through the Fake
+    handler.  Public API for constructors that need a concrete value
+    mid-``deferred_init`` (e.g. a config table computed with jnp).
+    """
+    session, level = _tls.session, _tls.fake_level
+    _tls.session, _tls.fake_level = None, 0
+    try:
+        yield
+    finally:
+        _tls.session, _tls.fake_level = session, level
 
 
 class FakeArray:
