@@ -213,3 +213,52 @@ class TestT5GenerateEncDec:
         a = generate_encdec(m, enc, 4, temperature=0.9, key=jax.random.PRNGKey(1))
         b = generate_encdec(m, enc, 4, temperature=0.9, key=jax.random.PRNGKey(1))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestSamplingFilters:
+    def test_top_k_one_equals_greedy(self):
+        m = _model()
+        prompt = jnp.asarray([[3, 5, 7]], jnp.int32)
+        greedy = generate(m, prompt, 6)
+        topk1 = generate(
+            m, prompt, 6, temperature=1.0, top_k=1, key=jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+    def test_top_p_one_equals_plain_sampling(self):
+        m = _model()
+        prompt = jnp.asarray([[2, 4]], jnp.int32)
+        a = generate(m, prompt, 5, temperature=0.9, key=jax.random.PRNGKey(5))
+        b = generate(
+            m, prompt, 5, temperature=0.9, top_p=1.0, key=jax.random.PRNGKey(5)
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_filters_unit_semantics(self):
+        from torchdistx_tpu.generation import _apply_top_k, _apply_top_p
+
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        k2 = _apply_top_k(logits, 2)
+        assert bool(jnp.isfinite(k2[0, 0])) and bool(jnp.isfinite(k2[0, 1]))
+        assert not bool(jnp.isfinite(k2[0, 2])) and not bool(jnp.isfinite(k2[0, 3]))
+        # nucleus 0.6: keep tokens whose preceding mass < 0.6 -> {0.5, 0.3}
+        p6 = _apply_top_p(logits, 0.6)
+        assert bool(jnp.isfinite(p6[0, 0])) and bool(jnp.isfinite(p6[0, 1]))
+        assert not bool(jnp.isfinite(p6[0, 2]))
+        # always keeps at least top-1
+        p_tiny = _apply_top_p(logits, 1e-9)
+        assert bool(jnp.isfinite(p_tiny[0, 0]))
+        assert not bool(jnp.isfinite(p_tiny[0, 1]))
+
+    def test_invalid_filter_args_raise_loudly(self):
+        m = _model()
+        p = jnp.zeros((1, 3), jnp.int32)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(m, p, 2, temperature=1.0, top_k=0, key=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="top_p"):
+            generate(m, p, 2, temperature=1.0, top_p=0.0, key=jax.random.PRNGKey(0))
+        # top_k larger than vocab clamps instead of crashing mid-trace
+        out = generate(
+            m, p, 2, temperature=1.0, top_k=10**6, key=jax.random.PRNGKey(0)
+        )
+        assert out.shape == (1, 5)

@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 import torchdistx_tpu as tdx
@@ -128,3 +129,65 @@ def test_mismatched_batch_sharding_warns_once(mesh8):
         params, s, _ = step(params, s, (t, t))  # same layout: no second warn
     msgs = [str(w.message) for w in rec if "batch_spec" in str(w.message)]
     assert len(msgs) == 1  # once per distinct (sharding, shape) layout
+
+
+def test_gradient_accumulation_matches_full_batch(mesh8):
+    """accum_steps=2 must produce the same update as the full batch in one
+    pass (mean-reduced loss => averaged micro-gradients are identical)."""
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    tdx.manual_seed(9)
+    model = tdx.deferred_init(Llama.from_name, "tiny")
+    tdx.materialize_module(model, sharding_rule=llama_tp_rule(mesh, "tp"))
+    params = dict(model.named_parameters())
+
+    def loss_fn(p, batch):
+        t, l = batch
+        return functional.cross_entropy(functional_call(model, p, (t,)), l)
+
+    tokens, labels = _data(b=8, s=16)
+
+    outs = {}
+    for accum in (1, 2):
+        step = GSPMDTrainStep(
+            loss_fn,
+            optax.sgd(1e-2),
+            mesh,
+            batch_spec=P("dp"),
+            accum_steps=accum,
+        )
+        # fresh buffers per run: the jitted step donates params/opt_state
+        pcopy = jax.tree_util.tree_map(lambda x: x + 0, params)
+        s0 = step.init_optimizer(pcopy)
+        p1, _, loss = step(pcopy, s0, (tokens, labels))
+        outs[accum] = (p1, float(loss))
+
+    assert np.isclose(outs[1][1], outs[2][1], rtol=1e-5)
+    for k in outs[1][0]:
+        np.testing.assert_allclose(
+            np.asarray(outs[1][0][k]),
+            np.asarray(outs[2][0][k]),
+            rtol=3e-6,
+            atol=3e-7,
+            err_msg=k,
+        )
+
+
+def test_gradient_accumulation_indivisible_raises(mesh8):
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    tdx.manual_seed(9)
+    model = tdx.deferred_init(Llama.from_name, "tiny")
+    tdx.materialize_module(model, sharding_rule=llama_tp_rule(mesh, "tp"))
+    params = dict(model.named_parameters())
+
+    def loss_fn(p, batch):
+        t, l = batch
+        return functional.cross_entropy(functional_call(model, p, (t,)), l)
+
+    step = GSPMDTrainStep(
+        loss_fn, optax.sgd(1e-2), mesh, batch_spec=P("dp"), accum_steps=3
+    )
+    pcopy = jax.tree_util.tree_map(lambda x: x + 0, params)
+    s0 = step.init_optimizer(pcopy)
+    tokens, labels = _data(b=8, s=16)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(pcopy, s0, (tokens, labels))

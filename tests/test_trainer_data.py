@@ -151,3 +151,20 @@ class TestTrainer:
         )
         out2 = trainer2.fit(iter(dl), num_steps=15)
         assert out2["step"] == 15
+
+
+def test_cost_summary():
+    import jax.numpy as jnp
+
+    from torchdistx_tpu.utils.profiling import cost_summary
+
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((64, 32)); b = jnp.ones((32, 16))
+    out = cost_summary(f, a, b, peak_flops=1e12)
+    # matmul flops = 2*64*32*16
+    assert out["flops"] >= 2 * 64 * 32 * 16 * 0.9
+    assert out["bytes_accessed"] > 0
+    assert out["arithmetic_intensity"] > 0
+    assert out["compute_bound_s"] == out["flops"] / 1e12

@@ -22,11 +22,47 @@ from .nn.module import functional_call
 __all__ = ["generate", "generate_encdec"]
 
 
-def _make_sampler(temperature: float, out_dtype):
+def _apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    top_k = min(int(top_k), logits.shape[-1])  # clamp to vocab
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of the sorted
+    distribution whose mass reaches ``top_p`` (always at least top-1)."""
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_l = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p  # mass BEFORE this token is under p
+    keep = keep.at[..., 0].set(True)  # the promise: at least top-1
+    masked = jnp.where(keep, sorted_l, -jnp.inf)
+    inv = jnp.argsort(sort_idx, axis=-1)
+    return jnp.take_along_axis(masked, inv, axis=-1)
+
+
+def _check_sampling_args(top_k, top_p) -> None:
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def _make_sampler(
+    temperature: float,
+    out_dtype,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
     def sample(logits_1, k):
         if temperature <= 0.0:
             return jnp.argmax(logits_1, axis=-1).astype(out_dtype)
         scaled = logits_1.astype(jnp.float32) / temperature
+        if top_k is not None:
+            scaled = _apply_top_k(scaled, top_k)
+        if top_p is not None:
+            scaled = _apply_top_p(scaled, top_p)
         return jax.random.categorical(k, scaled, axis=-1).astype(out_dtype)
 
     return sample
@@ -77,16 +113,21 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     key: Optional[jax.Array] = None,
     params: Optional[dict] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, S).
 
     ``temperature == 0`` is greedy; otherwise samples with the given
-    temperature (``key`` required).  Returns (B, S + max_new_tokens).
+    temperature (``key`` required), optionally filtered to the ``top_k``
+    highest-probability tokens and/or the ``top_p`` nucleus.  Returns
+    (B, S + max_new_tokens).
     """
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    _check_sampling_args(top_k, top_p)
     params = params if params is not None else dict(model.named_parameters())
     key = key if key is not None else jax.random.PRNGKey(0)
     b, s = prompt.shape
@@ -114,7 +155,7 @@ def generate(
         logits, cache = apply_step(prompt, cache, 0)
         toks = _decode_tokens(
             apply_step,
-            _make_sampler(temperature, prompt.dtype),
+            _make_sampler(temperature, prompt.dtype, top_k, top_p),
             cache,
             logits[:, -1],
             key,
@@ -124,7 +165,10 @@ def generate(
         return jnp.concatenate([prompt, toks], axis=1)
 
     jitted = _cached_jit(
-        model, "_generate_cache", (b, s, max_new, float(temperature)), run
+        model,
+        "_generate_cache",
+        (b, s, max_new, float(temperature), top_k, top_p),
+        run,
     )
     return jitted(params, prompt, key)
 
@@ -136,6 +180,8 @@ def generate_encdec(
     *,
     start_token: int = 0,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     key: Optional[jax.Array] = None,
     params: Optional[dict] = None,
 ) -> jax.Array:
@@ -150,6 +196,7 @@ def generate_encdec(
         raise ValueError("max_new_tokens must be positive")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    _check_sampling_args(top_k, top_p)
     params = params if params is not None else dict(model.named_parameters())
     key = key if key is not None else jax.random.PRNGKey(0)
     b = enc_tokens.shape[0]
@@ -170,7 +217,7 @@ def generate_encdec(
         logits, cache = apply_step(tok0, cache, 0)
         return _decode_tokens(
             apply_step,
-            _make_sampler(temperature, jnp.int32),
+            _make_sampler(temperature, jnp.int32, top_k, top_p),
             cache,
             logits[:, -1],
             key,
@@ -181,7 +228,15 @@ def generate_encdec(
     jitted = _cached_jit(
         model,
         "_generate_encdec_cache",
-        (b, enc_tokens.shape[1], max_new, float(temperature), start_token),
+        (
+            b,
+            enc_tokens.shape[1],
+            max_new,
+            float(temperature),
+            top_k,
+            top_p,
+            start_token,
+        ),
         run,
     )
     return jitted(params, enc_tokens, key)

@@ -23,6 +23,7 @@ import re
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .fsdp import fsdp_partition_spec, optimizer_state_shardings
@@ -97,13 +98,71 @@ class GSPMDTrainStep:
     optimizer: Any
     mesh: Mesh
     batch_spec: P = P()
+    # microbatch gradient accumulation: the global batch's leading dim is
+    # split into accum_steps microbatches scanned sequentially, gradients
+    # accumulated in f32 — the standard fit-a-bigger-batch lever
+    accum_steps: int = 1
 
     def __post_init__(self) -> None:
         opt = self.optimizer
         loss_fn = self.loss_fn
+        accum = int(self.accum_steps)
+        if accum < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum}")
+
+        def grad_of(params, batch):
+            return jax.value_and_grad(loss_fn)(params, batch)
 
         def step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if accum == 1:
+                loss, grads = grad_of(params, batch)
+            else:
+                leads = {
+                    getattr(x, "shape", ())[:1]
+                    for x in jax.tree_util.tree_leaves(batch)
+                }
+                if len(leads) != 1 or leads == {()}:
+                    raise ValueError(
+                        "gradient accumulation requires every batch leaf "
+                        f"to share one batch-major leading dim; got leading "
+                        f"dims {sorted(leads)}"
+                    )
+                (lead,) = next(iter(leads))
+                if lead % accum != 0:
+                    raise ValueError(
+                        f"batch leading dim {lead} not divisible by "
+                        f"accum_steps={accum}"
+                    )
+
+                def split(x):
+                    # STRIDED microbatches — microbatch i takes rows
+                    # [i::accum] — so each keeps the full dp extent of the
+                    # batch sharding; a contiguous (accum, lead/accum)
+                    # reshape would park every microbatch on one dp slice
+                    return jnp.moveaxis(
+                        x.reshape(lead // accum, accum, *x.shape[1:]), 1, 0
+                    )
+
+                micro = jax.tree_util.tree_map(split, batch)
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
+
+                def body(carry, mb):
+                    loss_acc, g_acc = carry
+                    loss, grads = grad_of(params, mb)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+                    )
+                    return (loss_acc + loss, g_acc), None
+
+                (loss_sum, g_sum), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), g0), micro
+                )
+                loss = loss_sum / accum
+                grads = jax.tree_util.tree_map(
+                    lambda p, g: (g / accum).astype(p.dtype), params, g_sum
+                )
             updates, opt_state = opt.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates

@@ -12,7 +12,13 @@ from typing import Any, Iterator, Optional
 
 import jax
 
-__all__ = ["trace", "annotate", "device_memory_stats", "format_memory_stats"]
+__all__ = [
+    "trace",
+    "annotate",
+    "device_memory_stats",
+    "format_memory_stats",
+    "cost_summary",
+]
 
 
 @contextlib.contextmanager
@@ -28,6 +34,34 @@ def trace(log_dir: str) -> Iterator[None]:
 def annotate(name: str):
     """Named region that shows up on the profiler timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def cost_summary(fn: Any, *args: Any, peak_flops: Optional[float] = None, **kwargs: Any) -> dict:
+    """XLA cost analysis of ``fn(*args)`` — compile-time FLOP and memory-
+    traffic counts, the first stop when a measured MFU looks wrong.
+
+    ``fn`` may be jitted or plain (it is jitted here).  Nothing executes:
+    the function is lowered and compiled only.  Returns
+    ``{"flops", "bytes_accessed", "arithmetic_intensity", "output_bytes",
+    ...}`` plus, with ``peak_flops`` (e.g. 197e12 for v5e bf16), a
+    ``compute_bound_s`` roofline floor; for the memory side divide
+    ``bytes_accessed`` by your HBM bandwidth.
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    out = {
+        "flops": flops,
+        "bytes_accessed": byts,
+        "arithmetic_intensity": flops / byts if byts else None,
+        "output_bytes": float(ca.get("bytes accessed output", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+    }
+    if peak_flops:
+        out["compute_bound_s"] = flops / peak_flops
+    return out
 
 
 def device_memory_stats(device: Optional[Any] = None) -> dict:
