@@ -131,3 +131,66 @@ def test_llama_use_flash_matches_default():
     np.testing.assert_allclose(
         np.asarray(a(tokens)), np.asarray(b(tokens)), rtol=2e-4, atol=2e-4
     )
+
+
+class TestBias:
+    """Additive logit bias (T5 relative-position bias) on the flash path."""
+
+    @staticmethod
+    def _inputs(b=2, s=32, h=4, d=16, key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 4)
+        q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+        bias = jax.random.normal(ks[3], (h, s, s), jnp.float32)
+        return q, k, v, bias
+
+    @staticmethod
+    def _reference(q, k, v, bias, causal):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = logits / np.sqrt(q.shape[-1]) + bias[None]
+        if causal:
+            s = q.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        q, k, v, bias = self._inputs()
+        out = flash_attention(
+            q, k, v, bias=bias, causal=causal, block_q=8, block_k=8
+        )
+        ref = self._reference(q, k, v, bias, causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gradients_including_dbias(self):
+        q, k, v, bias = self._inputs(s=16)
+
+        def flash_loss(q, k, v, b):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, bias=b, causal=True, block_q=8, block_k=8
+                ).astype(jnp.float32) ** 2
+            )
+
+        def ref_loss(q, k, v, b):
+            return jnp.sum(
+                self._reference(q, k, v, b, True).astype(jnp.float32) ** 2
+            )
+
+        gf = jax.grad(flash_loss, (0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(ref_loss, (0, 1, 2, 3))(q, k, v, bias)
+        for name, a, b in zip("qkvB", gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5,
+                err_msg=f"d{name}",
+            )
+
+    def test_bad_bias_shape_raises(self):
+        q, k, v, bias = self._inputs()
+        with pytest.raises(ValueError, match="bias shape"):
+            flash_attention(q, k, v, bias=bias[:, :8], causal=False)

@@ -1,6 +1,8 @@
 """Model families: construction (eager + deferred), forward shapes, jit,
 parameter counts, ring attention equivalence."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -128,3 +130,28 @@ class TestRingAttention:
             check_vma=False,
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(full), rtol=2e-4, atol=2e-5)
+
+
+class TestT5Flash:
+    def test_flash_self_attention_matches_einsum(self):
+        from torchdistx_tpu.models import T5
+
+        tdx.manual_seed(31)
+        m = tdx.deferred_init(T5.from_name, "tiny")
+        tdx.materialize_module(m)
+        params = dict(m.named_parameters())
+        enc = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (2, 24)), jnp.int32
+        )
+        dec = jnp.asarray(
+            np.random.RandomState(1).randint(0, 256, (2, 16)), jnp.int32
+        )
+        base = functional_call(m, params, (enc, dec))
+        for blk in list(m.enc_blocks) + list(m.dec_blocks):
+            blk.self_attn.cfg = dataclasses.replace(
+                blk.self_attn.cfg, use_flash=True
+            )
+        flash = functional_call(m, params, (enc, dec))
+        np.testing.assert_allclose(
+            np.asarray(base), np.asarray(flash), rtol=3e-5, atol=3e-5
+        )

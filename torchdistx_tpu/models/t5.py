@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..ops.attention import cached_attention
+from ..ops.flash_attention import resolve_use_flash
 
 __all__ = ["T5Config", "T5", "t5_configs"]
 
@@ -31,6 +32,14 @@ class T5Config:
     rel_pos_max_dist: int = 128
     norm_eps: float = 1e-6
     dtype: object = jnp.float32
+    # pallas flash attention for SELF-attention (bias streamed into the
+    # kernel).  None = auto: on for TPU, off elsewhere (interpret-mode
+    # pallas on CPU is exact but slow).  Cross-attention stays einsum.
+    # NOTE: the (H, Sq, Skv) bias itself still materializes in HBM, so
+    # T5 does not inherit flash's O(S) memory ceiling — computing the
+    # bucket bias in-kernel from the (buckets, H) table would; until
+    # then, very long T5 contexts should use sequence parallelism.
+    use_flash: object = None
 
 
 t5_configs = {
@@ -124,6 +133,7 @@ class T5Attention(nn.Module):
     def forward(self, x, kv=None, causal=False, bias=None):
         cfg = self.cfg
         b, sq, _ = x.shape
+        is_self = kv is None
         kv = x if kv is None else kv
         skv = kv.shape[1]
         q = self.q(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
@@ -132,14 +142,21 @@ class T5Attention(nn.Module):
         if bias is None and self.rel_bias is not None:
             bias = self._bias(sq, skv)
         # T5 uses unscaled dot products (scale folded into init)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        if bias is not None:
-            logits = logits + bias[None].astype(jnp.float32)
-        if causal:
-            mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
-            logits = jnp.where(mask, logits, -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if is_self and resolve_use_flash(cfg.use_flash):
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, bias=bias, causal=causal, scale=1.0
+            )
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            if bias is not None:
+                logits = logits + bias[None].astype(jnp.float32)
+            if causal:
+                mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+                logits = jnp.where(mask, logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)), bias
 
 

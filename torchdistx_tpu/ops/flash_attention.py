@@ -35,18 +35,20 @@ def _kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *rest,
     scale: float,
     causal: bool,
     block_q: int,
     block_k: int,
     n_k: int,
     diag_offset: int,
+    has_bias: bool,
 ):
+    if has_bias:
+        bias_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        bias_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -79,6 +81,8 @@ def _kernel(
             )
             * scale
         )  # (block_q, block_k)
+        if has_bias:
+            logits = logits + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = (
                 qi * block_q
@@ -108,13 +112,16 @@ def _kernel(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash_attention_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_attention_vjp(
+    q, k, v, bias, causal, scale, block_q, block_k, interpret
+):
     return _flash_forward(
         q,
         k,
         v,
+        bias=bias,
         causal=causal,
         scale=scale,
         block_q=block_q,
@@ -123,24 +130,26 @@ def _flash_attention_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
     )
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     out = _flash_forward(
         q,
         k,
         v,
+        bias=bias,
         causal=causal,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, bias)
 
 
-def _attention_chunk(qc, k, v, row_offset, causal, scale):
+def _attention_chunk(qc, k, v, bias_rows, row_offset, causal, scale):
     """Reference attention for a Q chunk whose first global row is
     ``row_offset`` (traced), against the full K/V.  f32 softmax, same math
-    as ``multihead_attention``."""
+    as ``multihead_attention``.  ``bias_rows``: optional (H, cq, Skv)
+    additive logit bias slice."""
     b, cq, hq, d = qc.shape
     _, skv, hkv, _ = k.shape
     if hq != hkv:
@@ -149,6 +158,8 @@ def _attention_chunk(qc, k, v, row_offset, causal, scale):
         v = jnp.repeat(v, n_rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", qc, k).astype(jnp.float32) * s
+    if bias_rows is not None:
+        logits = logits + bias_rows[None].astype(jnp.float32)
     if causal:
         rows = row_offset + jnp.arange(cq)[:, None]
         cols = jnp.arange(skv)[None, :]
@@ -163,7 +174,7 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     # via jax.vjp, accumulating dK/dV across chunks under lax.scan.  Peak
     # memory is O(chunk * Skv) — the flash working-set profile — instead of
     # the O(Sq * Skv) a whole-matrix recompute would allocate.
-    q, k, v = res
+    q, k, v, bias = res
     b, sq, hq, d = q.shape
     _, skv, _, _ = k.shape
     chunk = min(block_q, sq)
@@ -172,30 +183,42 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     n_chunks = sq // chunk
     diag_offset = skv - sq
 
+    has_bias = bias is not None
+
     def body(carry, idx):
         dk_acc, dv_acc = carry
         qs = jax.lax.dynamic_slice_in_dim(q, idx * chunk, chunk, axis=1)
         gs = jax.lax.dynamic_slice_in_dim(g, idx * chunk, chunk, axis=1)
         row_offset = idx * chunk + diag_offset
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _attention_chunk(
-                q_, k_, v_, row_offset, causal, scale
-            ),
-            qs,
-            k,
-            v,
+        operands = (qs, k, v) + (
+            (jax.lax.dynamic_slice_in_dim(bias, idx * chunk, chunk, axis=1),)
+            if has_bias
+            else ()
         )
-        dq_c, dk_c, dv_c = vjp(gs)
-        return (dk_acc + dk_c, dv_acc + dv_c), dq_c
 
-    (dk, dv), dq_chunks = jax.lax.scan(
+        def chunk_fn(q_, k_, v_, *b_):
+            return _attention_chunk(
+                q_, k_, v_, b_[0] if b_ else None, row_offset, causal, scale
+            )
+
+        _, vjp = jax.vjp(chunk_fn, *operands)
+        grads = vjp(gs)
+        dq_c, dk_c, dv_c = grads[:3]
+        db_c = grads[3] if has_bias else jnp.zeros((), jnp.float32)
+        return (dk_acc + dk_c, dv_acc + dv_c), (dq_c, db_c)
+
+    (dk, dv), (dq_chunks, db_chunks) = jax.lax.scan(
         body,
         (jnp.zeros_like(k), jnp.zeros_like(v)),
         jnp.arange(n_chunks),
     )
     # (n_chunks, B, chunk, H, D) -> (B, Sq, H, D)
     dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(b, sq, hq, d)
-    return dq, dk, dv
+    if bias is None:
+        return dq, dk, dv, None
+    # (n_chunks, H, chunk, Skv) -> (H, Sq, Skv)
+    dbias = jnp.moveaxis(db_chunks, 0, 1).reshape(hq, sq, skv).astype(bias.dtype)
+    return dq, dk, dv, dbias
 
 
 _flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -216,6 +239,7 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     *,
+    bias: Optional[jax.Array] = None,
     causal: bool = True,
     scale: Optional[float] = None,
     block_q: int = 256,
@@ -223,11 +247,16 @@ def flash_attention(
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Differentiable entry point: flash kernel forward, recomputed
-    reference backward (see ``_flash_bwd_rule``)."""
+    reference backward (see ``_flash_bwd_rule``).
+
+    ``bias``: optional additive logit bias of shape (Hq, Sq, Skv), shared
+    across the batch — T5's relative-position bias.  Streamed blockwise
+    into the kernel; differentiable (the backward emits dbias).
+    """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _flash_attention_vjp(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, bias, causal, scale, block_q, block_k, interpret
     )
 
 
@@ -240,6 +269,7 @@ def _flash_forward(
     k: jax.Array,
     v: jax.Array,
     *,
+    bias: Optional[jax.Array] = None,
     causal: bool = True,
     scale: Optional[float] = None,
     block_q: int = 256,
@@ -283,6 +313,24 @@ def _flash_forward(
         # combined q index c = batch * hq + h  ->  batch * hkv + h // n_rep
         return (c // hq) * hkv + (c % hq) // n_rep, kk, 0
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    operands = [qh, kh, vh]
+    if bias is not None:
+        if bias.shape != (hq, sq, skv):
+            raise ValueError(
+                f"bias shape {bias.shape} != (Hq, Sq, Skv) = "
+                f"{(hq, sq, skv)}"
+            )
+        # bias is shared across the batch: program c maps to head c % hq
+        in_specs.append(
+            pl.BlockSpec((1, block_q, block_k), lambda c, i, kk: (c % hq, i, kk))
+        )
+        operands.append(bias)
+
     out = pl.pallas_call(
         functools.partial(
             _kernel,
@@ -292,13 +340,10 @@ def _flash_forward(
             block_k=block_k,
             n_k=n_k,
             diag_offset=skv - sq,
+            has_bias=bias is not None,
         ),
         grid=(b * hq, sq // block_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
         scratch_shapes=[
@@ -310,5 +355,5 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qh, kh, vh)
+    )(*operands)
     return jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
