@@ -108,6 +108,7 @@ def ring_attention(
     axis: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention over sequence shards.  Must run inside ``shard_map``
     with the sequence dim sharded over ``axis``.
@@ -121,6 +122,12 @@ def ring_attention(
     block currently held being ``j``, the block is fully visible when
     ``j < i``, diagonal (``j == i``) applies the local causal mask, and
     future blocks contribute nothing.
+
+    ``bias``: optional additive logit bias of shape
+    (H, sq_local, S_global) — this shard's global query rows against ALL
+    key positions (T5's relative-position bias under sequence
+    parallelism).  The rotating block index selects each hop's column
+    slice, so only O(S) bias per device is needed.
     """
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -144,6 +151,11 @@ def ring_attention(
             jnp.einsum("bqhd,bkhd->bhqk", q, kb_full).astype(jnp.float32)
             * scale_
         )
+        if bias is not None:
+            # the block we hold is shard j's keys: global columns
+            # [j * skv, (j + 1) * skv)
+            bias_blk = lax.dynamic_slice_in_dim(bias, j * skv, skv, axis=2)
+            logits = logits + bias_blk[None].astype(jnp.float32)
         if causal:
             visible = jnp.where(
                 j < idx,
