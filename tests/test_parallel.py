@@ -403,3 +403,47 @@ class TestShardedTrainStep:
         assert losses[-1] < losses[0] * 0.7
         final = step.consensus(p)
         assert final["fc1.weight"].shape == (32, 16)
+
+
+class TestShardedAccumulation:
+    def test_accum_matches_full_batch(self, mesh8):
+        """ShardedTrainStep accum_steps=2 must reproduce the full-batch
+        update (same samples, averaged gradients; hook runs once)."""
+        tdx.manual_seed(12)
+        model = tdx.deferred_init(MLP)
+        tdx.materialize_module(model)
+        params = dict(model.named_parameters())
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((functional_call(model, p, (x,)) - y) ** 2)
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(16, 16), jnp.float32)
+        y = jnp.sum(x[:, :4], axis=1, keepdims=True)
+
+        outs = {}
+        for accum in (1, 2):
+            step = ShardedTrainStep(
+                loss_fn,
+                optax.sgd(1e-2),
+                mesh8,
+                shard_axis="fsdp",
+                accum_steps=accum,
+            )
+            p = step.shard_params(
+                jax.tree_util.tree_map(lambda a: a + 0, params)
+            )
+            s = step.init_optimizer(p)
+            p, s, loss = step(p, s, (x, y))
+            outs[accum] = (p, float(loss))
+
+        assert np.isclose(outs[1][1], outs[2][1], rtol=1e-5)
+        for k in outs[1][0]:
+            np.testing.assert_allclose(
+                np.asarray(outs[1][0][k]),
+                np.asarray(outs[2][0][k]),
+                rtol=3e-6,
+                atol=3e-7,
+                err_msg=k,
+            )

@@ -133,6 +133,14 @@ def ring_attention(
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
+    if bias is not None and bias.shape != (hq, sq, n * skv):
+        # dynamic_slice would CLAMP a too-short key dim (e.g. a bias
+        # mistakenly sharded on its key axis) into silently wrong logits
+        raise ValueError(
+            f"ring_attention bias shape {bias.shape} != (H, sq_local, "
+            f"S_global) = {(hq, sq, n * skv)} — keep the key dim of the "
+            "bias UNsharded (in_specs P(None, axis, None))"
+        )
     # GQA: keep K/V at hkv heads while they travel the ring (1/n_rep the
     # ppermute bytes — the whole point of GQA on the long-context path) and
     # broadcast over query-head groups only inside each local block step.

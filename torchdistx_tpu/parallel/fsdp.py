@@ -47,6 +47,70 @@ __all__ = [
 ]
 
 
+def accumulate_grads(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    params: Any,
+    batch: Any,
+    accum: int,
+    split_fn: Callable[[Any, int, int], Any],
+):
+    """Shared microbatch gradient accumulation: validate the batch's
+    common leading dim, split it with ``split_fn(leaf, lead, accum)``
+    (callers inject contiguous vs strided strategies), scan
+    ``value_and_grad`` over the microbatches accumulating in f32, and
+    return ``(mean_loss, grads_in_param_dtype)``."""
+    if accum == 1:
+        return jax.value_and_grad(loss_fn)(params, batch)
+    leads = {
+        getattr(x, "shape", ())[:1] for x in jax.tree_util.tree_leaves(batch)
+    }
+    if len(leads) != 1 or leads == {()}:
+        raise ValueError(
+            "gradient accumulation requires every batch leaf to share one "
+            f"batch-major leading dim; got leading dims {sorted(leads)}"
+        )
+    (lead,) = next(iter(leads))
+    if lead % accum != 0:
+        raise ValueError(
+            f"batch leading dim {lead} not divisible by accum_steps={accum}"
+        )
+    micro = jax.tree_util.tree_map(
+        lambda x: split_fn(x, lead, accum), batch
+    )
+    g0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+    def body(carry, mb):
+        loss_acc, g_acc = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+        g_acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+        )
+        return (loss_acc + loss, g_acc), None
+
+    (loss_sum, g_sum), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), g0), micro
+    )
+    grads = jax.tree_util.tree_map(
+        lambda p, g: (g / accum).astype(p.dtype), params, g_sum
+    )
+    return loss_sum / accum, grads
+
+
+def contiguous_split(x, lead, accum):
+    """(lead, ...) -> (accum, lead/accum, ...): right inside shard_map,
+    where the leaf is already this device's local shard."""
+    return x.reshape(accum, lead // accum, *x.shape[1:])
+
+
+def strided_split(x, lead, accum):
+    """Microbatch i takes rows [i::accum], so each keeps the full
+    data-parallel extent of a dp-sharded global batch (a contiguous split
+    would park every microbatch on one dp slice)."""
+    return jnp.moveaxis(x.reshape(lead // accum, accum, *x.shape[1:]), 1, 0)
+
+
 def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
     """Shardings for an optimizer state pytree: subtrees structurally equal
     to ``params`` (optax's per-parameter slots) inherit the parameter
@@ -144,6 +208,11 @@ class ShardedTrainStep:
     # full PartitionSpec for batch leaves (overrides batch_axes-on-dim0);
     # e.g. P('dp', 'sp') to shard tokens over batch AND sequence axes
     batch_spec: Optional[P] = None
+    # microbatch gradient accumulation: each device splits its LOCAL batch
+    # shard into accum_steps microbatches scanned sequentially (params are
+    # all-gathered once per step, not per microbatch); gradients accumulate
+    # in f32 and the comm hook runs once, on the accumulated gradient
+    accum_steps: int = 1
 
     def __post_init__(self) -> None:
         if self.hook_state is None:
@@ -278,15 +347,24 @@ class ShardedTrainStep:
             ax for ax in data_axes if ax not in ctx_axes and ax != shard_axis
         )
 
+        accum = int(self.accum_steps)
+        if accum < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum}")
+
+        def local_grad(p, batch):
+            # inside shard_map the batch leaf is this device's local shard,
+            # so a contiguous split is correct
+            return accumulate_grads(loss_fn, p, batch, accum, contiguous_split)
+
         def grad_part(p_shards, batch, hook_step):
             full = tree_with_specs(gather_leaf, p_shards)
             if divergent:
                 # local view: drop the (size-1 per replica) leading dim
                 local = jax.tree_util.tree_map(lambda x: x[0], full)
-                loss, grads = jax.value_and_grad(loss_fn)(local, batch)
+                loss, grads = local_grad(local, batch)
                 grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             else:
-                loss, grads = jax.value_and_grad(loss_fn)(full, batch)
+                loss, grads = local_grad(full, batch)
             if grad_reduce_axes:
                 grads = jax.tree_util.tree_map(
                     lambda g: lax.pmean(g, grad_reduce_axes), grads

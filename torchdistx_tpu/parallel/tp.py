@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .fsdp import fsdp_partition_spec, optimizer_state_shardings
+from .fsdp import (
+    accumulate_grads,
+    fsdp_partition_spec,
+    optimizer_state_shardings,
+    strided_split,
+)
 
 __all__ = ["tp_shard_rule", "llama_tp_rule", "GSPMDTrainStep"]
 
@@ -110,59 +115,12 @@ class GSPMDTrainStep:
         if accum < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum}")
 
-        def grad_of(params, batch):
-            return jax.value_and_grad(loss_fn)(params, batch)
-
         def step(params, opt_state, batch):
-            if accum == 1:
-                loss, grads = grad_of(params, batch)
-            else:
-                leads = {
-                    getattr(x, "shape", ())[:1]
-                    for x in jax.tree_util.tree_leaves(batch)
-                }
-                if len(leads) != 1 or leads == {()}:
-                    raise ValueError(
-                        "gradient accumulation requires every batch leaf "
-                        f"to share one batch-major leading dim; got leading "
-                        f"dims {sorted(leads)}"
-                    )
-                (lead,) = next(iter(leads))
-                if lead % accum != 0:
-                    raise ValueError(
-                        f"batch leading dim {lead} not divisible by "
-                        f"accum_steps={accum}"
-                    )
-
-                def split(x):
-                    # STRIDED microbatches — microbatch i takes rows
-                    # [i::accum] — so each keeps the full dp extent of the
-                    # batch sharding; a contiguous (accum, lead/accum)
-                    # reshape would park every microbatch on one dp slice
-                    return jnp.moveaxis(
-                        x.reshape(lead // accum, accum, *x.shape[1:]), 1, 0
-                    )
-
-                micro = jax.tree_util.tree_map(split, batch)
-                g0 = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params
-                )
-
-                def body(carry, mb):
-                    loss_acc, g_acc = carry
-                    loss, grads = grad_of(params, mb)
-                    g_acc = jax.tree_util.tree_map(
-                        lambda a, g: a + g.astype(jnp.float32), g_acc, grads
-                    )
-                    return (loss_acc + loss, g_acc), None
-
-                (loss_sum, g_sum), _ = jax.lax.scan(
-                    body, (jnp.zeros((), jnp.float32), g0), micro
-                )
-                loss = loss_sum / accum
-                grads = jax.tree_util.tree_map(
-                    lambda p, g: (g / accum).astype(p.dtype), params, g_sum
-                )
+            # strided microbatches keep the full dp extent of the global
+            # batch sharding (see strided_split)
+            loss, grads = accumulate_grads(
+                loss_fn, params, batch, accum, strided_split
+            )
             updates, opt_state = opt.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
