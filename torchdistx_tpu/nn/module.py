@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Iterator, Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..fake import FakeArray
 
@@ -190,6 +191,54 @@ class Module:
             mod._buffers[leaf] = value
         else:
             raise KeyError(f"no parameter or buffer at {path!r}")
+
+    def apply(self, fn: Any) -> "Module":
+        """Apply ``fn`` to every submodule (children first) and self —
+        torch parity (``Module.apply``), e.g. custom re-init passes."""
+        for child in self.children():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def to(self, dtype: Any = None, sharding: Any = None) -> "Module":
+        """Convert every parameter and buffer in place: cast to ``dtype``
+        and/or place into ``sharding`` (a Sharding, or a rule
+        ``(path, leaf) -> Sharding|None`` like ``materialize_module``'s).
+
+        The torch ``module.to(dtype)/.half()`` analog: like torch, only
+        FLOATING-point entries are cast (integer/bool buffers — counters,
+        position ids, masks — keep their dtype).  Works on real arrays;
+        fake entries raise BEFORE anything mutates (transactional), so a
+        failed call leaves the module unchanged — materialize first, or
+        materialize directly into a sharding.
+        """
+        entries = self.state_dict()
+        if dtype is not None or sharding is not None:
+            bad = [
+                p for p, v in entries.items() if not isinstance(v, jax.Array)
+            ]
+            if bad:
+                raise TypeError(
+                    f"Module.to: {bad[0]!r} is not a real array "
+                    f"({type(entries[bad[0]]).__name__}); materialize first"
+                )
+        for path, value in entries.items():
+            new = value
+            if (
+                dtype is not None
+                and new.dtype != dtype
+                and jnp.issubdtype(new.dtype, jnp.floating)
+            ):
+                new = new.astype(dtype)
+            if sharding is not None:
+                target = (
+                    sharding(path, new) if callable(sharding) else sharding
+                )
+                if target is not None:
+                    new = jax.device_put(new, target)
+            if new is not value:
+                self._set_by_path(path, new)
+        return self
 
     def train(self, mode: bool = True) -> "Module":
         object.__setattr__(self, "training", mode)
