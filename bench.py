@@ -24,9 +24,6 @@ import math
 import resource
 import time
 
-V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
-
-
 def _set_platform():
     # smoke-testing hook: the axon sitecustomize pins JAX_PLATFORMS, so a
     # CPU run must override via jax.config BEFORE the first device use
@@ -41,78 +38,36 @@ def _set_platform():
 
 def _train_throughput():
     _set_platform()
-    import jax
-    import jax.numpy as jnp
+    import time as _time
+
     import numpy as np
 
-    import torchdistx_tpu as tdx
-    from torchdistx_tpu.models import Llama, llama_configs
-    from torchdistx_tpu.nn import functional
-    from torchdistx_tpu.nn.module import functional_call
-    from torchdistx_tpu.optimizers import anyprecision_adamw
-
-    import os
-
-    name = os.environ.get("TDX_BENCH_TRAIN_MODEL", "llama_1b")
-    batch, seq = 2, int(os.environ.get("TDX_BENCH_SEQ", "2048"))
-    tdx.manual_seed(0)
-    model = tdx.deferred_init(Llama.from_name, name, max_seq_len=seq)
-    tdx.materialize_module(model)
-    params = dict(model.named_parameters())
-    n_params = model.num_params()
-
-    tx = anyprecision_adamw(1e-4)
-    opt_state = tx.init(params)
-
-    def loss_fn(p, tokens, labels):
-        logits = functional_call(model, p, (tokens,))
-        return functional.cross_entropy(logits, labels)
-
-    def step(carry, _):
-        p, s = carry
-        loss, grads = jax.value_and_grad(loss_fn)(p, tokens, labels)
-        updates, s = tx.update(grads, s, p)
-        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
-        return (p, s), loss
+    from torchdistx_tpu.utils.benchmarks import (
+        V5E_PEAK_BF16 as _PEAK,
+        build_train_workload,
+    )
 
     n_steps = 20
-
-    # N steps inside ONE jitted lax.scan: per-call dispatch through the
-    # axon relay costs ~2s/call, which would swamp the measurement; a
-    # device-side loop times what the chip actually sustains.  Donation
-    # reuses the params/optimizer buffers (the chip is nearly full).
-    from jax import lax
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run(carry):
-        return lax.scan(step, carry, None, length=n_steps)
-
-    vocab = llama_configs[name].get("vocab_size", 32000)
-    rs = np.random.RandomState(0)
-    tokens = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
+    w = build_train_workload(n_steps)
+    run, carry = w["run"], w["carry"]
 
     # warm (compile) + sync via host fetch (relay-proof)
-    (params, opt_state), losses = run((params, opt_state))
+    carry, losses = run(carry)
     float(np.asarray(losses[-1]))
 
-    t0 = time.perf_counter()
-    (params, opt_state), losses = run((params, opt_state))
+    t0 = _time.perf_counter()
+    carry, losses = run(carry)
     final_loss = float(np.asarray(losses[-1]))  # forces the whole chain
-    dt = time.perf_counter() - t0
+    dt = _time.perf_counter() - t0
 
-    toks = n_steps * batch * seq
+    toks = n_steps * w["batch"] * w["seq"]
     tokens_per_sec = toks / dt
-    cfg = llama_configs[name]
-    # model FLOPs per token: 6N for fwd+bwd matmuls + attention term
-    # 12 * L * dim * seq (PaLM appendix convention)
-    flops_per_token = 6 * n_params + 12 * cfg["n_layers"] * cfg["dim"] * seq
-    mfu = tokens_per_sec * flops_per_token / V5E_PEAK_BF16
+    mfu = tokens_per_sec * w["flops_per_token"] / _PEAK
     return {
-        "train_model": name,
-        "train_params": int(n_params),
-        "train_batch": batch,
-        "train_seq": seq,
+        "train_model": w["name"],
+        "train_params": w["n_params"],
+        "train_batch": w["batch"],
+        "train_seq": w["seq"],
         "train_steps_timed": n_steps,
         "train_window_s": round(dt, 3),
         "train_final_loss": round(final_loss, 4)

@@ -1,0 +1,73 @@
+"""Profile the single-chip training step (the bench.py phase-2 workload).
+
+Produces, in one run:
+  - an XLA profiler trace (view in TensorBoard/XProf) of N timed steps,
+  - the compiled step's cost analysis (FLOPs, bytes accessed, arithmetic
+    intensity) via utils.profiling.cost_summary,
+  - device memory stats after the run.
+
+This is the round-3 entry point for the MFU investigation: the measured
+5.5% MFU (BENCH r2) with an XLA-counted ~0.87x-of-formula FLOP count and
+very high bytes-accessed suggests an HBM-bound step — the trace says
+where.
+
+Usage:  python scripts/profile_train_step.py [--logdir /tmp/tdx-trace]
+        TDX_BENCH_TRAIN_MODEL=llama_1b TDX_BENCH_SEQ=2048 control the
+        workload like bench.py's train phase.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--logdir", default="/tmp/tdx-trace")
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    p = os.environ.get("TDX_BENCH_PLATFORM")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+    import numpy as np
+
+    from torchdistx_tpu.utils import profiling
+    from torchdistx_tpu.utils.benchmarks import (
+        V5E_PEAK_BF16,
+        build_train_workload,
+    )
+
+    # the SAME workload bench.py scores (shared builder)
+    w = build_train_workload(args.steps)
+    run, carry = w["run"], w["carry"]
+
+    # cost analysis BEFORE executing (compile-only)
+    cs = profiling.cost_summary(run, carry, peak_flops=V5E_PEAK_BF16)
+    print(json.dumps({"cost_analysis": cs, "workload": {
+        k: w[k] for k in ("name", "n_params", "batch", "seq")
+    }}))
+
+    # warm (compile) outside the trace
+    carry, losses = run(carry)
+    float(np.asarray(losses[-1]))
+
+    with profiling.trace(args.logdir):
+        with profiling.annotate("timed_steps"):
+            carry, losses = run(carry)
+            final = float(np.asarray(losses[-1]))
+
+    print(json.dumps({"final_loss": round(final, 4), "trace": args.logdir}))
+    print(profiling.format_memory_stats())
+
+
+if __name__ == "__main__":
+    main()

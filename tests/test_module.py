@@ -150,3 +150,16 @@ class TestApplyAndTo:
         assert all(
             not isinstance(v, jax.Array) for v in m.state_dict().values()
         )
+
+    def test_to_rejects_non_float_dtype(self):
+        m = Tiny()
+        with pytest.raises(TypeError, match="floating-point"):
+            m.to(dtype=jnp.int32)
+
+    def test_to_accepts_numpy_entries(self):
+        import numpy as np
+
+        m = Tiny()
+        m.register_buffer("host_buf", np.ones((3,), np.float32))
+        m.to(dtype=jnp.bfloat16)  # numpy entries convert, not rejected
+        assert m._buffers["host_buf"].dtype == jnp.bfloat16

@@ -207,21 +207,28 @@ class Module:
 
         The torch ``module.to(dtype)/.half()`` analog: like torch, only
         FLOATING-point entries are cast (integer/bool buffers — counters,
-        position ids, masks — keep their dtype).  Works on real arrays;
-        fake entries raise BEFORE anything mutates (transactional), so a
-        failed call leaves the module unchanged — materialize first, or
-        materialize directly into a sharding.
+        position ids, masks — keep their dtype) and a non-float target
+        dtype is rejected.  Transactional: every new value is computed
+        before anything is stored, so a failed call (fake entries, a
+        sharding that does not fit some leaf, ...) leaves the module
+        unchanged.
         """
+        if dtype is not None and not jnp.issubdtype(
+            jnp.dtype(dtype), jnp.floating
+        ):
+            # torch parity: nn.Module.to only accepts floating dtypes
+            raise TypeError(
+                f"Module.to only accepts floating-point dtypes, got {dtype}"
+            )
         entries = self.state_dict()
         if dtype is not None or sharding is not None:
-            bad = [
-                p for p, v in entries.items() if not isinstance(v, jax.Array)
-            ]
+            bad = [p for p, v in entries.items() if isinstance(v, FakeArray)]
             if bad:
                 raise TypeError(
-                    f"Module.to: {bad[0]!r} is not a real array "
-                    f"({type(entries[bad[0]]).__name__}); materialize first"
+                    f"Module.to: {bad[0]!r} is a fake array; materialize "
+                    "first (or materialize directly into a sharding)"
                 )
+        staged: dict[str, Any] = {}
         for path, value in entries.items():
             new = value
             if (
@@ -237,7 +244,9 @@ class Module:
                 if target is not None:
                     new = jax.device_put(new, target)
             if new is not value:
-                self._set_by_path(path, new)
+                staged[path] = new
+        for path, new in staged.items():  # commit only after all succeeded
+            self._set_by_path(path, new)
         return self
 
     def train(self, mode: bool = True) -> "Module":

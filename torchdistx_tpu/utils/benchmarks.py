@@ -1,0 +1,88 @@
+"""Shared single-chip training workload for bench.py and the profiler.
+
+``bench.py --train-phase`` measures this workload's throughput and
+``scripts/profile_train_step.py`` traces the SAME workload — sharing the
+builder keeps "what we profile" identical to "what we score".
+
+Env overrides (smoke tests / experiments): ``TDX_BENCH_TRAIN_MODEL``,
+``TDX_BENCH_BATCH``, ``TDX_BENCH_SEQ``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any
+
+V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+
+
+def build_train_workload(n_steps: int) -> dict[str, Any]:
+    """Build the benchmark training workload: a 1B-class Llama LM step
+    (flash attention on TPU, AnyPrecisionAdamW, remat, bf16).
+
+    Returns ``{"run", "carry", "name", "n_params", "batch", "seq",
+    "flops_per_token"}`` where ``run(carry) -> (carry, losses)`` executes
+    ``n_steps`` device-side (lax.scan) with donated buffers.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    import torchdistx_tpu as tdx
+    from torchdistx_tpu.models import Llama, llama_configs
+    from torchdistx_tpu.nn import functional
+    from torchdistx_tpu.nn.module import functional_call
+    from torchdistx_tpu.optimizers import anyprecision_adamw
+
+    name = os.environ.get("TDX_BENCH_TRAIN_MODEL", "llama_1b")
+    batch = int(os.environ.get("TDX_BENCH_BATCH", "2"))
+    seq = int(os.environ.get("TDX_BENCH_SEQ", "2048"))
+
+    tdx.manual_seed(0)
+    model = tdx.deferred_init(Llama.from_name, name, max_seq_len=seq)
+    tdx.materialize_module(model)
+    params = dict(model.named_parameters())
+    n_params = model.num_params()
+
+    tx = anyprecision_adamw(1e-4)
+    opt_state = tx.init(params)
+
+    cfg = llama_configs[name]
+    vocab = cfg.get("vocab_size", 32000)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
+
+    def loss_fn(p):
+        return functional.cross_entropy(
+            functional_call(model, p, (tokens,)), labels
+        )
+
+    def step(carry, _):
+        p, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = tx.update(grads, s, p)
+        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        return (p, s), loss
+
+    # N steps in ONE jitted lax.scan: per-call dispatch through the axon
+    # relay would swamp the measurement; donation reuses the params/
+    # optimizer buffers (the chip is nearly full)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(carry):
+        return lax.scan(step, carry, None, length=n_steps)
+
+    # model FLOPs per token: 6N for fwd+bwd matmuls + attention term
+    # 12 * L * dim * seq (PaLM appendix convention)
+    flops_per_token = 6 * n_params + 12 * cfg["n_layers"] * cfg["dim"] * seq
+    return {
+        "run": run,
+        "carry": (params, opt_state),
+        "name": name,
+        "n_params": int(n_params),
+        "batch": batch,
+        "seq": seq,
+        "flops_per_token": flops_per_token,
+    }
