@@ -293,3 +293,89 @@ class TestThreadedRecord:
         g.pin(a)
         _mark(g, a)
         assert g.unpin(a) is True
+
+
+class TestRandomizedInvariants:
+    """Property-style stress: random DAGs under random pin/materialize/
+    unpin interleavings must preserve the core invariants the replay
+    engine relies on (states only move recorded -> materialized ->
+    released; a node is released only when materialized, unpinned, and
+    free of unmaterialized dependents; a schedule is exactly the
+    unmaterialized transitive dependency closure)."""
+
+    def _closure(self, deps_of, target, materialized):
+        out, stack = set(), [target]
+        while stack:
+            n = stack.pop()
+            if n in out or n in materialized:
+                continue
+            out.add(n)
+            stack.extend(d for d in deps_of[n] if d not in out)
+        return out
+
+    def test_random_dags(self):
+        import random as pyrandom
+
+        rng = pyrandom.Random(1234)
+        for trial in range(25):
+            g = NativeGraph()
+            n_nodes = rng.randint(5, 40)
+            deps_of = {}
+            pins = {}
+            for i in range(n_nodes):
+                k = rng.randint(0, min(i, 4))
+                deps = rng.sample(range(i), k) if k else []
+                nid = g.record_op(f"n{i}", deps, 1)
+                assert nid == i
+                deps_of[nid] = deps
+                pins[nid] = 0
+                if rng.random() < 0.5:
+                    g.pin(nid)
+                    pins[nid] += 1
+
+            materialized: set = set()
+            released: set = set()
+
+            def model_release_check():
+                for n in range(n_nodes):
+                    s = g.node_state(n)
+                    if s == NODE_RELEASED:
+                        assert n in materialized, (trial, n, "released before mat")
+                        assert pins[n] == 0, (trial, n, "released while pinned")
+                    if n in released:
+                        assert s == NODE_RELEASED, (trial, n, "resurrected")
+
+            for _ in range(3 * n_nodes):
+                op = rng.random()
+                n = rng.randrange(n_nodes)
+                if op < 0.4 and n not in materialized:
+                    # materialize: check the schedule first
+                    sched = g.collect_schedule(n)
+                    expect = self._closure(deps_of, n, materialized)
+                    assert set(sched) == expect, (trial, n)
+                    assert sched == sorted(sched)
+                    for m in sched:
+                        released.update(g.mark_materialized(m))
+                        materialized.add(m)
+                elif op < 0.7 and pins[n] > 0:
+                    if g.unpin(n):
+                        released.add(n)
+                    pins[n] -= 1
+                elif op < 0.85:
+                    if g.node_state(n) != NODE_RELEASED:
+                        g.pin(n)
+                        pins[n] += 1
+                model_release_check()
+
+            # drain: everything materializes, all pins drop -> all released
+            for n in range(n_nodes):
+                if n not in materialized:
+                    for m in g.collect_schedule(n):
+                        released.update(g.mark_materialized(m))
+                        materialized.add(m)
+            for n in range(n_nodes):
+                while pins[n] > 0:
+                    g.unpin(n)
+                    pins[n] -= 1
+            assert g.num_materialized() == n_nodes
+            assert g.num_released() == n_nodes
