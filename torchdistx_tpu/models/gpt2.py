@@ -67,7 +67,9 @@ class GPT2Block(nn.Module):
         self.attn_out = nn.Linear(d, d, dtype=cfg.dtype, weight_init=w_res, bias_init=_zeros_init)
         self.ln2 = nn.LayerNorm(d, eps=cfg.norm_eps, dtype=cfg.dtype)
         self.mlp_up = nn.Linear(d, 4 * d, dtype=cfg.dtype, weight_init=w, bias_init=_zeros_init)
-        self.mlp_down = nn.Linear(4 * d, d, dtype=cfg.dtype, weight_init=w_res, bias_init=_zeros_init)
+        self.mlp_down = nn.Linear(
+            4 * d, d, dtype=cfg.dtype, weight_init=w_res, bias_init=_zeros_init
+        )
         self.n_heads = cfg.n_heads
 
     def forward(self, x):
