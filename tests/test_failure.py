@@ -93,6 +93,28 @@ class TestHeartbeat:
         assert Heartbeat.is_stale(path, max_age_s=0.0)
         assert Heartbeat.is_stale(str(tmp_path / "missing"), 5.0)
 
+    def test_transient_write_error_does_not_kill_thread(self, tmp_path):
+        # A failed beat (e.g. disk full) must not end the daemon loop:
+        # liveness reporting resumes once writes succeed again.
+        subdir = tmp_path / "sub"
+        subdir.mkdir()
+        path = str(subdir / "hb")
+        hb = Heartbeat(path, interval_s=0.05)
+        with hb:
+            time.sleep(0.12)
+            import os
+
+            # rename (not rmtree) so a concurrent beat creating hb.tmp
+            # can't race the directory scan; later beats raise OSError
+            os.rename(subdir, tmp_path / "quarantine")
+            time.sleep(0.15)
+            assert hb._thread.is_alive()
+            assert hb.write_failures > 0
+            subdir.mkdir()  # writable again
+            time.sleep(0.15)
+            assert os.path.exists(path)
+            assert hb.write_failures == 0
+
 
 def _make_trainer(tmp_path, inject_nan_after, on_failure, detector):
     class M(nn.Module):

@@ -323,9 +323,26 @@ class RecordingSession:
             sched = sorted(sched_set)
 
             if sched:
-                self._replay(sched, sched_set, set(pending), resolved_targets={
-                    t: s for t, s in zip(targets, resolved_shardings)
-                })
+                # Replay must execute for REAL: suspend the caller's
+                # fake/deferred mode so recorded creation closures that call
+                # the interposed jnp surface (ops._intercept) do not re-fake
+                # and record stray nodes mid-replay.  This bites when a
+                # terminal op forces materialization *inside* an active
+                # deferred_init() (the reference handles it with its
+                # NoDeferredInit RAII guard around replay,
+                # deferred_init.cc:769).
+                from .fake import no_deferred_init
+
+                with no_deferred_init():
+                    self._replay(
+                        sched,
+                        sched_set,
+                        set(pending),
+                        resolved_targets={
+                            t: s
+                            for t, s in zip(targets, resolved_shardings)
+                        },
+                    )
 
             out: list[Any] = []
             for t, sh in zip(targets, resolved_shardings):

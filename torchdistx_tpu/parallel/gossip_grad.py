@@ -34,6 +34,17 @@ back through the permutation:
                  xor position falls outside the topology
   DISSEMINATION: send to topo[(node_rank + 2**power) % n],
                  recv from topo[(node_rank - 2**power) % n]
+
+Deliberate deviation for ``num_modules > 1``: the reference re-evaluates
+``next(topologies)`` on *every* iteration where
+``(iter // num_modules) % gossip_period == 0`` (gossip_grad.py:373-380), so
+with k>1 FSDP modules it burns k draws from the cycle at the start of each
+rotation window — an artifact of calling the hook once per module, not a
+schedule intent.  This implementation draws exactly ONE topology per
+rotation window regardless of ``num_modules`` (``current_topology_idx``
+caches per rotation), so the k>1 topology sequence differs from the
+reference's; for ``num_modules == 1`` (the default here) the schedules are
+identical.
 """
 
 from __future__ import annotations

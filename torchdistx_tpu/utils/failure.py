@@ -177,6 +177,7 @@ class Heartbeat:
         self.path = path
         self.interval_s = interval_s
         self.step = 0
+        self.write_failures = 0  # consecutive; resets on success
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -188,7 +189,17 @@ class Heartbeat:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self._beat()
+            # A transient write error (disk full, path briefly unavailable)
+            # must not permanently end liveness reporting while training
+            # continues — a dead heartbeat makes the supervisor kill a
+            # healthy job.  Count consecutive failures for observability;
+            # the next successful beat resets the counter.
+            try:
+                self._beat()
+            except OSError:
+                self.write_failures += 1
+            else:
+                self.write_failures = 0
 
     def start(self) -> "Heartbeat":
         self._beat()
