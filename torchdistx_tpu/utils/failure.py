@@ -202,6 +202,10 @@ class Heartbeat:
                 self.write_failures = 0
 
     def start(self) -> "Heartbeat":
+        # Deliberately unguarded: a write failure HERE is almost always a
+        # misconfigured path and must fail fast at startup, before the
+        # supervisor starts trusting this file — only the steady-state
+        # loop tolerates transient errors.
         self._beat()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
