@@ -130,6 +130,51 @@ class TestCatchAllInterception:
             s = jrandom.normal(key, (8,))
             assert tdx.is_fake(s)
 
+    def test_jax_nn_initializers_are_intercepted(self):
+        # Third-party ctor code calls jax.nn.initializers — the closures
+        # must not silently allocate under the mode (reference parity: the
+        # catch-all really catches everything, fake.cc:546-548).  The
+        # interposition hooks the internal module's call-time globals, so
+        # even closures created BEFORE any patch (e.g. flax's import-time
+        # default_kernel_init) are covered.
+        import jax.nn.initializers as ini
+
+        from torchdistx_tpu.ops import _intercept
+
+        try:
+            _intercept.uninstall()
+            pre_patch = ini.lecun_normal()  # closure made w/ NO patch active
+            key = jax.random.PRNGKey(0)
+            with tdx.fake_mode():
+                assert tdx.is_fake(pre_patch(key, (64, 32)))
+                assert tdx.is_fake(ini.zeros(key, (16,)))
+                # orthogonal exercises the jnp.linalg.qr submodule path
+                assert tdx.is_fake(ini.orthogonal()(key, (8, 8)))
+            # outside the mode the same closure is real again
+            assert isinstance(pre_patch(key, (4, 4)), jax.Array)
+        finally:
+            _intercept.ensure_installed()
+
+    def test_initializer_deferred_replay_bit_identical(self):
+        import numpy as np
+
+        import jax.nn.initializers as ini
+
+        def build():
+            k = jax.random.PRNGKey(7)
+            return {
+                "w": ini.glorot_uniform()(k, (32, 16)),
+                "q": ini.orthogonal()(k, (16, 16)),
+            }
+
+        m = tdx.deferred_init(build)
+        assert tdx.is_fake(m["w"]) and tdx.is_fake(m["q"])
+        w = tdx.materialize_tensor(m["w"])
+        q = tdx.materialize_tensor(m["q"])
+        eager = build()
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(eager["w"]))
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(eager["q"]))
+
     def test_math_on_fakes_works_in_and_out_of_mode(self):
         with tdx.fake_mode():
             z = jnp.ones((3, 3))

@@ -1,15 +1,24 @@
 """Pipeline parallelism: pipelined forward/backward must exactly equal
-sequential layer application."""
+sequential layer application — for the GPipe forward (autodiff backward)
+and the 1F1B train step (manual backward pipeline), on real transformer
+stages produced by deferred_init."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.nn import functional_call
 from torchdistx_tpu.parallel import create_mesh
-from torchdistx_tpu.parallel.pp import pipeline_apply, stack_pipeline_stages
+from torchdistx_tpu.parallel.pp import (
+    pipeline_apply,
+    pipeline_train_step,
+    split_microbatches,
+    stack_pipeline_stages,
+)
 
 
 def _stages(n_stages, d, key=0):
@@ -119,3 +128,188 @@ class TestPipeline:
         mesh = create_mesh({"pp": 4}, devices=jax.devices()[:4])
         with pytest.raises(ValueError, match="stages"):
             stack_pipeline_stages(_stages(3, 8), mesh)
+
+
+def _mse(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _seq_loss(stage_list, micro, tgt, stage_fn, loss_fn=_mse):
+    tot = 0.0
+    for i in range(micro.shape[0]):
+        x = micro[i]
+        for p in stage_list:
+            x = stage_fn(p, x)
+        tot = tot + loss_fn(x, tgt[i])
+    return tot / micro.shape[0]
+
+
+class TestPipelineTrainStep:
+    """1F1B schedule: loss and per-stage grads must match the unpipelined
+    model's autodiff exactly (CPU f32 is exact)."""
+
+    def test_loss_and_grads_match_sequential(self):
+        mesh = create_mesh({"pp": 4}, devices=jax.devices()[:4])
+        stages = _stages(4, 16, key=10)
+        stacked = stack_pipeline_stages(stages, mesh)
+        rs = np.random.RandomState(11)
+        mb = jnp.asarray(rs.randn(6, 8, 16).astype(np.float32))
+        tgt = jnp.asarray(rs.randn(6, 8, 16).astype(np.float32))
+
+        loss, g = pipeline_train_step(
+            stacked, mb, tgt, mesh=mesh, stage_fn=_stage_fn, loss_fn=_mse
+        )
+        l_ref, g_ref = jax.value_and_grad(_seq_loss)(
+            stages, mb, tgt, _stage_fn
+        )
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-6)
+        for i in range(4):
+            for k in ("w", "b"):
+                np.testing.assert_allclose(
+                    np.asarray(g[k][i]),
+                    np.asarray(g_ref[i][k]),
+                    rtol=1e-5,
+                    atol=1e-6,
+                )
+
+    def test_fewer_micro_than_stages(self):
+        # M < S: warmup/cooldown masks must keep the math exact
+        mesh = create_mesh({"pp": 4}, devices=jax.devices()[:4])
+        stages = _stages(4, 8, key=12)
+        stacked = stack_pipeline_stages(stages, mesh)
+        rs = np.random.RandomState(13)
+        mb = jnp.asarray(rs.randn(2, 4, 8).astype(np.float32))
+        tgt = jnp.asarray(rs.randn(2, 4, 8).astype(np.float32))
+        loss, g = pipeline_train_step(
+            stacked, mb, tgt, mesh=mesh, stage_fn=_stage_fn, loss_fn=_mse
+        )
+        l_ref, g_ref = jax.value_and_grad(_seq_loss)(
+            stages, mb, tgt, _stage_fn
+        )
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(g["w"][0]), np.asarray(g_ref[0]["w"]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_composed_dp_axis(self):
+        # batch sharded over dp (NOT replicated to every stage); grads
+        # pmean over dp must equal the global-batch sequential grads
+        mesh = create_mesh({"dp": 2, "pp": 4})
+        stages = _stages(4, 8, key=14)
+        stacked = stack_pipeline_stages(stages, mesh)
+        rs = np.random.RandomState(15)
+        mb = jnp.asarray(rs.randn(4, 8, 8).astype(np.float32))
+        tgt = jnp.asarray(rs.randn(4, 8, 8).astype(np.float32))
+        mb = jax.device_put(mb, NamedSharding(mesh, P(None, "dp")))
+        tgt = jax.device_put(tgt, NamedSharding(mesh, P(None, "dp")))
+        loss, g = pipeline_train_step(
+            stacked, mb, tgt,
+            mesh=mesh, stage_fn=_stage_fn, loss_fn=_mse, dp_axis="dp",
+        )
+        l_ref, g_ref = jax.value_and_grad(_seq_loss)(
+            stages, mb, tgt, _stage_fn
+        )
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-6)
+        for i in range(4):
+            np.testing.assert_allclose(
+                np.asarray(g["w"][i]), np.asarray(g_ref[i]["w"]),
+                rtol=1e-5, atol=1e-6,
+            )
+
+    def test_training_reduces_loss(self):
+        import optax
+
+        mesh = create_mesh({"pp": 4}, devices=jax.devices()[:4])
+        stages = _stages(4, 8, key=16)
+        stacked = stack_pipeline_stages(stages, mesh)
+        rs = np.random.RandomState(17)
+        batch = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+        target = jnp.zeros((16, 8), jnp.float32)  # learnable target
+        mb = split_microbatches(batch, 4)
+        tgt = split_microbatches(target, 4)
+        tx = optax.sgd(0.3)
+        s = tx.init(stacked)
+
+        @jax.jit
+        def step(p, s):
+            loss, g = pipeline_train_step(
+                p, mb, tgt, mesh=mesh, stage_fn=_stage_fn, loss_fn=_mse
+            )
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s, loss
+
+        losses = []
+        for _ in range(8):
+            stacked, s, loss = step(stacked, s)
+            losses.append(float(loss))
+        assert losses[-1] < 0.5 * losses[0]
+
+
+class TestLlamaPipeline:
+    """The VERDICT bar: stage params produced by deferred_init from real
+    Llama blocks, stacked with stack_pipeline_stages, trained with the
+    1F1B step — and the pipelined loss/grads equal the unpipelined
+    model's."""
+
+    def _cfg(self):
+        from torchdistx_tpu.models.llama import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=64,
+            dim=32,
+            n_layers=4,  # 1 block per stage on pp=4
+            n_heads=4,
+            n_kv_heads=2,
+            max_seq_len=16,
+            dtype=jnp.float32,
+            use_flash=False,
+        )
+
+    def test_llama_blocks_deferred_init_pp_matches_unpipelined(self):
+        from torchdistx_tpu.models.llama import pp_stage
+
+        cfg = self._cfg()
+        Stage = pp_stage(cfg)
+        mesh = create_mesh({"dp": 2, "pp": 4})
+
+        # one deferred-init per stage; materialize; stack over pp
+        stage_params = []
+        for i in range(4):
+            tdx.manual_seed(100 + i)
+            m = tdx.deferred_init(Stage)
+            assert tdx.is_deferred(m)
+            tdx.materialize_module(m)
+            stage_params.append(dict(m.named_parameters()))
+        stacked = stack_pipeline_stages(stage_params, mesh)
+
+        template = Stage()  # structure only; params bound per call
+        stage_fn = lambda p, x: functional_call(template, p, (x,))  # noqa: E731
+
+        rs = np.random.RandomState(21)
+        B, S = 4, 8
+        hidden = jnp.asarray(rs.randn(8, B, S, cfg.dim).astype(np.float32))
+        tgt = jnp.asarray(rs.randn(8, B, S, cfg.dim).astype(np.float32))
+
+        # reference on the plain (unsharded) arrays first
+        l_ref, g_ref = jax.value_and_grad(_seq_loss)(
+            stage_params, hidden, tgt, stage_fn
+        )
+
+        hidden = jax.device_put(hidden, NamedSharding(mesh, P(None, "dp")))
+        tgt = jax.device_put(tgt, NamedSharding(mesh, P(None, "dp")))
+        loss, g = pipeline_train_step(
+            stacked, hidden, tgt,
+            mesh=mesh, stage_fn=stage_fn, loss_fn=_mse, dp_axis="dp",
+        )
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+        ref_by_stage = [jax.tree_util.tree_leaves(gr) for gr in g_ref]
+        pp_leaves = jax.tree_util.tree_leaves(g)
+        for i in range(4):
+            for pp_leaf, ref_leaf in zip(pp_leaves, ref_by_stage[i]):
+                np.testing.assert_allclose(
+                    np.asarray(pp_leaf[i]),
+                    np.asarray(ref_leaf),
+                    rtol=2e-4,
+                    atol=1e-5,
+                )

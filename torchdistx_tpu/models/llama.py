@@ -24,7 +24,7 @@ from ..nn import init as nn_init
 from ..ops.attention import cached_attention, multihead_attention, ring_attention
 from ..ops.flash_attention import resolve_use_flash
 
-__all__ = ["LlamaConfig", "Llama", "llama_configs"]
+__all__ = ["LlamaConfig", "Llama", "llama_configs", "pp_stage"]
 
 
 @dataclasses.dataclass
@@ -258,3 +258,28 @@ class Llama(nn.Module):
             new_cache.append(c)
         x = self.norm(x)
         return self.lm_head(x), new_cache
+
+
+def pp_stage(cfg: LlamaConfig, n_blocks: int = 1):
+    """Module class for one pipeline stage: ``n_blocks`` LlamaBlocks with a
+    uniform ``forward(x) -> x`` signature (rope recomputed per call from the
+    config — parameter-free), as ``parallel.pp`` stage functions require.
+    Instantiate under ``deferred_init`` per stage, materialize, then
+    ``stack_pipeline_stages``; bind params per call with ``functional_call``
+    on one template instance.
+    """
+
+    class LlamaStage(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.ModuleList(
+                [LlamaBlock(cfg) for _ in range(n_blocks)]
+            )
+
+        def forward(self, x):
+            rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+            for blk in self.blocks:
+                x = blk(x, rope)
+            return x
+
+    return LlamaStage

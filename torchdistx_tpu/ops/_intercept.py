@@ -13,6 +13,14 @@ routes through :func:`ops.apply_op` (shape propagation / recording);
 everything else passes straight through to the original with only a cheap
 argument scan.
 
+``jax.nn.initializers`` is covered at its *call-time globals*: initializer
+closures (``glorot_uniform()``'s returned ``init``) resolve ``random.X`` /
+``jnp.X`` from ``jax._src.nn.initializers``'s module dict on every call, so
+interposing those two module attributes catches every initializer — even
+closures created before the patch (e.g. third-party defaults captured at
+import, like flax's ``default_kernel_init``), which a patch of the public
+``jax.nn.initializers`` namespace would miss.
+
 Scope and limitations (documented divergence from a true dispatcher hook):
   - only attribute lookups through the module namespace are intercepted;
     references captured *before* the patch (``from jax.numpy import zeros``)
@@ -192,6 +200,58 @@ def _wrappable(obj: Any) -> bool:
     return callable(obj)
 
 
+class _ModuleProxy:
+    """Interposing stand-in for a module referenced from another module's
+    globals (``jax._src.nn.initializers``'s ``random`` and ``jnp``).
+
+    Attribute access returns the original attribute wrapped with the same
+    fake-aware dispatch as the public-namespace patch: fake args or a
+    creation call under the mode route through ``apply_op``; everything
+    else passes through.  Submodules (``jnp.linalg``) proxy recursively so
+    e.g. the ``orthogonal`` initializer's ``jnp.linalg.qr`` propagates
+    fakes instead of raising JAX's invalid-type error.
+    """
+
+    def __init__(self, mod: Any, creation: set, label: str) -> None:
+        self.__dict__["__wrapped_original__"] = mod
+        self.__dict__["_creation"] = creation
+        self.__dict__["_label"] = label
+        self.__dict__["_cache"] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        cache = self.__dict__["_cache"]
+        if name in cache:
+            return cache[name]
+        mod = self.__dict__["__wrapped_original__"]
+        orig = getattr(mod, name)
+        if name in _METADATA_PASSTHROUGH:
+            # same invariant as the public patch: metadata fns must keep
+            # their static int/dtype outputs, never abstract into avals
+            cache[name] = orig
+            return orig
+        if isinstance(orig, types.ModuleType):
+            out: Any = _ModuleProxy(
+                orig,
+                self.__dict__["_creation"],
+                f"{self.__dict__['_label']}.{name}",
+            )
+        elif _wrappable(orig):
+            out = _make_wrapper(
+                f"{self.__dict__['_label']}.{name}",
+                orig,
+                name in self.__dict__["_creation"],
+            )
+            if _is_ufunc_like(orig):
+                out = _InterposedUfunc(out, orig)
+        else:
+            out = orig
+        cache[name] = out
+        return out
+
+    def __repr__(self) -> str:
+        return f"<interposed {self.__dict__['__wrapped_original__']!r}>"
+
+
 class _Patcher:
     """Installs the wrappers once and leaves them in place: a FakeArray can
     outlive the context that created it, and parity requires ops on it to
@@ -227,6 +287,31 @@ class _Patcher:
                 wrapper = _make_wrapper(f"random_{name}", orig, True)
                 self._saved.append((jax.random, name, orig))
                 setattr(jax.random, name, wrapper)
+            # jax.nn.initializers: interpose the internal module's call-time
+            # globals so every initializer closure is covered regardless of
+            # when it was created (see module docstring).  Samplers are
+            # creation ops (a real key in, an array out); jnp creation
+            # names mirror the public patch (covers the zeros/ones
+            # initializers).
+            try:
+                from jax._src.nn import initializers as _ini_internal
+            except ImportError:  # jax layout changed: public patch only
+                _ini_internal = None
+            if _ini_internal is not None:
+                for attr, target, creation in (
+                    ("random", getattr(_ini_internal, "random", None),
+                     _RANDOM_CREATION),
+                    ("jnp", getattr(_ini_internal, "jnp", None),
+                     _JNP_CREATION),
+                ):
+                    if not isinstance(target, types.ModuleType):
+                        continue
+                    self._saved.append((_ini_internal, attr, target))
+                    setattr(
+                        _ini_internal,
+                        attr,
+                        _ModuleProxy(target, creation, attr),
+                    )
 
     def uninstall(self) -> None:
         with self._lock:

@@ -4,7 +4,12 @@ from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
 from .gossip_grad import GossipGraDState, Topology, gossip_grad_hook
 from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
 from .multihost import init_multihost, is_multihost, process_count, process_index
-from .pp import pipeline_apply, stack_pipeline_stages
+from .pp import (
+    pipeline_apply,
+    pipeline_train_step,
+    split_microbatches,
+    stack_pipeline_stages,
+)
 from .tp import GSPMDTrainStep, llama_tp_rule, tp_shard_rule
 
 __all__ = [
@@ -28,6 +33,8 @@ __all__ = [
     "process_index",
     "process_count",
     "pipeline_apply",
+    "pipeline_train_step",
+    "split_microbatches",
     "stack_pipeline_stages",
     "GSPMDTrainStep",
     "llama_tp_rule",
