@@ -45,15 +45,19 @@ def _train_throughput():
     from torchdistx_tpu.utils.benchmarks import (
         V5E_PEAK_BF16 as _PEAK,
         build_train_workload,
+        warm_to_steady_state,
     )
 
     n_steps = 20
     w = build_train_workload(n_steps)
     run, carry = w["run"], w["carry"]
 
-    # warm (compile) + sync via host fetch (relay-proof)
-    carry, losses = run(carry)
-    float(np.asarray(losses[-1]))
+    # warm to the layout fixpoint — a single warm call would time the
+    # donated-carry recompile, round-2's measurement bug (see
+    # utils.benchmarks.warm_to_steady_state)
+    carry, warm_times, warm_converged = warm_to_steady_state(
+        run, carry, sync=lambda losses: float(np.asarray(losses[-1]))
+    )
 
     t0 = _time.perf_counter()
     carry, losses = run(carry)
@@ -69,6 +73,9 @@ def _train_throughput():
         "train_batch": w["batch"],
         "train_seq": w["seq"],
         "train_steps_timed": n_steps,
+        "train_warm_calls_s": [round(t, 2) for t in warm_times],
+        # False would mean the timed window may still contain a recompile
+        "train_warm_converged": warm_converged,
         "train_window_s": round(dt, 3),
         "train_final_loss": round(final_loss, 4)
         if math.isfinite(final_loss)

@@ -44,6 +44,7 @@ def main() -> None:
     from torchdistx_tpu.utils.benchmarks import (
         V5E_PEAK_BF16,
         build_train_workload,
+        warm_to_steady_state,
     )
 
     # the SAME workload bench.py scores (shared builder)
@@ -56,9 +57,19 @@ def main() -> None:
         k: w[k] for k in ("name", "n_params", "batch", "seq")
     }}))
 
-    # warm (compile) outside the trace
-    carry, losses = run(carry)
-    float(np.asarray(losses[-1]))
+    # warm to the layout fixpoint outside the trace — a single warm call
+    # would put the donated-carry recompile inside the traced window,
+    # round-2's measurement bug (see utils.benchmarks.warm_to_steady_state;
+    # shared with bench.py so what we profile stays what we score)
+    carry, _, warm_converged = warm_to_steady_state(
+        run, carry, sync=lambda losses: float(np.asarray(losses[-1]))
+    )
+    if not warm_converged:
+        print(
+            json.dumps({"warning": "warm-up did not reach the compile "
+                        "fixpoint; the trace may contain a recompile"}),
+            file=sys.stderr,
+        )
 
     with profiling.trace(args.logdir):
         with profiling.annotate("timed_steps"):

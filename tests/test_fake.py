@@ -155,6 +155,30 @@ class TestCatchAllInterception:
         finally:
             _intercept.ensure_installed()
 
+    def test_module_proxy_resolves_rebinding_live(self, monkeypatch):
+        # The initializer-globals proxy caches wrappers per underlying
+        # object identity, so a later rebinding of the sampler in the
+        # module the proxy stands in for (jax._src.random — the module
+        # initializer closures actually resolve through) must take effect
+        # inside those closures exactly as it does for direct callers.
+        import jax._src.random as internal_random
+        import jax.nn.initializers as ini
+
+        key = jax.random.PRNGKey(0)
+        ini.uniform(1.0)(key, (4,))  # populate the proxy cache
+
+        real_uniform = internal_random.uniform
+        calls = []
+
+        def stub(key, shape=(), *args, **kwargs):
+            calls.append(tuple(shape))
+            return real_uniform(key, shape, *args, **kwargs)
+
+        monkeypatch.setattr(internal_random, "uniform", stub)
+        out = ini.uniform(1.0)(key, (4,))
+        assert calls == [(4,)], "rebound sampler was not resolved live"
+        assert isinstance(out, jax.Array)
+
     def test_initializer_deferred_replay_bit_identical(self):
         import numpy as np
 

@@ -77,6 +77,36 @@ class TestCollectives:
         )
         np.testing.assert_allclose(np.asarray(out), np.array(recv, np.float32))
 
+    def test_exchange_invalid_peer_keeps_own_value(self, mesh8):
+        # INVALID_PEER members (no incoming edge) must NOT see zeros-that-
+        # look-like-data: the default fill="self" hands them their own
+        # value back (no-op exchange); fill="zero" restores raw ppermute
+        # semantics for callers with their own validity masks.
+        x = jnp.arange(8.0) + 1.0  # nonzero everywhere
+        # pair exchange among members 0-3 only; 4-7 are INVALID_PEER
+        send = [1, 0, 3, 2, -1, -1, -1, -1]
+        recv = [1, 0, 3, 2, -1, -1, -1, -1]
+        out = run_on_axis(
+            mesh8,
+            lambda v: collectives.exchange(v, "fsdp", send, recv),
+            x,
+            P("fsdp"),
+            P("fsdp"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.array([2, 1, 4, 3, 5, 6, 7, 8], np.float32)
+        )
+        out = run_on_axis(
+            mesh8,
+            lambda v: collectives.exchange(v, "fsdp", send, recv, fill="zero"),
+            x,
+            P("fsdp"),
+            P("fsdp"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.array([2, 1, 4, 3, 0, 0, 0, 0], np.float32)
+        )
+
     def test_exchange_inconsistent_peers_raises(self, mesh8):
         x = jnp.arange(8.0)
         send = [(i + 1) % 8 for i in range(8)]

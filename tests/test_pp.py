@@ -10,7 +10,6 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import torchdistx_tpu as tdx
-from torchdistx_tpu import nn
 from torchdistx_tpu.nn import functional_call
 from torchdistx_tpu.parallel import create_mesh
 from torchdistx_tpu.parallel.pp import (

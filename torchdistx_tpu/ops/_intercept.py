@@ -200,6 +200,16 @@ def _wrappable(obj: Any) -> bool:
     return callable(obj)
 
 
+def _wrap_callable(label: str, orig: Any, is_creation: bool) -> Any:
+    """The one wrap decision shared by the public-namespace patch and
+    ``_ModuleProxy``: fake-aware dispatch wrapper, ufunc-protocol shim on
+    top where the original is ufunc-like."""
+    wrapper = _make_wrapper(label, orig, is_creation)
+    if _is_ufunc_like(orig):
+        wrapper = _InterposedUfunc(wrapper, orig)
+    return wrapper
+
+
 class _ModuleProxy:
     """Interposing stand-in for a module referenced from another module's
     globals (``jax._src.nn.initializers``'s ``random`` and ``jnp``).
@@ -210,6 +220,11 @@ class _ModuleProxy:
     else passes through.  Submodules (``jnp.linalg``) proxy recursively so
     e.g. the ``orthogonal`` initializer's ``jnp.linalg.qr`` propagates
     fakes instead of raising JAX's invalid-type error.
+
+    Wrappers are cached per (name, underlying object identity): attribute
+    resolution stays LIVE — rebinding ``jax.random.uniform`` (a test
+    monkeypatch, say) after the proxy has been used invalidates the cached
+    wrapper, matching the behavior every non-proxied caller sees.
     """
 
     def __init__(self, mod: Any, creation: set, label: str) -> None:
@@ -219,33 +234,31 @@ class _ModuleProxy:
         self.__dict__["_cache"] = {}
 
     def __getattr__(self, name: str) -> Any:
-        cache = self.__dict__["_cache"]
-        if name in cache:
-            return cache[name]
         mod = self.__dict__["__wrapped_original__"]
         orig = getattr(mod, name)
+        cache = self.__dict__["_cache"]
+        hit = cache.get(name)
+        if hit is not None and hit[0] is orig:
+            return hit[1]
         if name in _METADATA_PASSTHROUGH:
             # same invariant as the public patch: metadata fns must keep
             # their static int/dtype outputs, never abstract into avals
-            cache[name] = orig
-            return orig
-        if isinstance(orig, types.ModuleType):
-            out: Any = _ModuleProxy(
+            out: Any = orig
+        elif isinstance(orig, types.ModuleType):
+            out = _ModuleProxy(
                 orig,
                 self.__dict__["_creation"],
                 f"{self.__dict__['_label']}.{name}",
             )
         elif _wrappable(orig):
-            out = _make_wrapper(
+            out = _wrap_callable(
                 f"{self.__dict__['_label']}.{name}",
                 orig,
                 name in self.__dict__["_creation"],
             )
-            if _is_ufunc_like(orig):
-                out = _InterposedUfunc(out, orig)
         else:
             out = orig
-        cache[name] = out
+        cache[name] = (orig, out)
         return out
 
     def __repr__(self) -> str:
@@ -275,16 +288,14 @@ class _Patcher:
                 orig = getattr(jnp, name, None)
                 if orig is None or not _wrappable(orig):
                     continue
-                wrapper = _make_wrapper(name, orig, name in _JNP_CREATION)
-                if _is_ufunc_like(orig):
-                    wrapper = _InterposedUfunc(wrapper, orig)
+                wrapper = _wrap_callable(name, orig, name in _JNP_CREATION)
                 self._saved.append((jnp, name, orig))
                 setattr(jnp, name, wrapper)
             for name in _RANDOM_CREATION:
                 orig = getattr(jax.random, name, None)
                 if orig is None or not _wrappable(orig):
                     continue
-                wrapper = _make_wrapper(f"random_{name}", orig, True)
+                wrapper = _wrap_callable(f"random_{name}", orig, True)
                 self._saved.append((jax.random, name, orig))
                 setattr(jax.random, name, wrapper)
             # jax.nn.initializers: interpose the internal module's call-time

@@ -65,15 +65,29 @@ def broadcast(tree: Any, axis: str, source: int = 0) -> Any:
     return jax.tree_util.tree_map(bc, tree)
 
 
-def exchange(tree: Any, axis: str, send_to: Sequence[int], recv_from: Sequence[int]) -> Any:
+def exchange(
+    tree: Any,
+    axis: str,
+    send_to: Sequence[int],
+    recv_from: Sequence[int],
+    *,
+    fill: str = "self",
+) -> Any:
     """Point-to-point exchange: member i sends its value to ``send_to[i]``
     and receives from ``recv_from[i]`` (the batch_isend_irecv analog).
 
     ``send_to`` defines the CollectivePermute; ``recv_from`` is accepted for
     API parity with the reference's peer bookkeeping and validated against
-    it.  A member with ``send_to[i] < 0`` sends nothing and receives zeros
-    (the reference's INVALID_PEER skip, gossip_grad.py:18-23,273-276).
+    it.  A member with no incoming edge (nobody sends to it — the
+    reference's INVALID_PEER skip, gossip_grad.py:18-23,273-276) keeps its
+    OWN value (``fill="self"``, the safe no-op-exchange default) rather
+    than the raw CollectivePermute zeros, which look like data to callers
+    that forget to mask.  ``fill="zero"`` restores the raw semantics for
+    callers that carry their own validity table (gossip_grad masks every
+    lane itself).
     """
+    if fill not in ("self", "zero"):
+        raise ValueError(f"fill must be 'self' or 'zero', got {fill!r}")
     perm = [(i, int(d)) for i, d in enumerate(send_to) if int(d) >= 0]
     if recv_from is not None:
         implied = {dst: src for src, dst in perm}
@@ -84,8 +98,19 @@ def exchange(tree: Any, axis: str, send_to: Sequence[int], recv_from: Sequence[i
                     f"from {src} but the send permutation delivers "
                     f"{implied.get(i)}"
                 )
+    receivers = {dst for _, dst in perm}
+    if fill == "zero" or len(receivers) == len(send_to):
+        return jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis, perm), tree
+        )
+    # static mask of members with an incoming edge, indexed by the traced
+    # axis position
+    has_incoming = jnp.asarray(
+        [i in receivers for i in range(len(send_to))]
+    )[lax.axis_index(axis)]
     return jax.tree_util.tree_map(
-        lambda x: lax.ppermute(x, axis, perm), tree
+        lambda x: jnp.where(has_incoming, lax.ppermute(x, axis, perm), x),
+        tree,
     )
 
 

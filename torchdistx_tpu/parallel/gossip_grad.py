@@ -242,7 +242,11 @@ def gossip_grad_hook(state: GossipGraDState, grads: Any, ctx: HookContext) -> An
         valid_arr = jnp.asarray(valid)
 
         def branch(g):
-            received = collectives.exchange(g, node_axis, send, recv)
+            # fill="zero": this hook masks every lane itself via the valid
+            # table below, so the self-fill safety net is redundant work
+            received = collectives.exchange(
+                g, node_axis, send, recv, fill="zero"
+            )
             ok = valid_arr[lax.axis_index(node_axis)]
             return jax.tree_util.tree_map(
                 lambda a, b: jnp.where(ok, (a + b) * 0.5, a), g, received

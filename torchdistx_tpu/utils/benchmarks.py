@@ -17,6 +17,51 @@ from typing import Any
 V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
 
 
+def warm_to_steady_state(run, carry, sync, max_calls: int = 5):
+    """Call ``run(carry) -> (carry, aux)`` until no call compiles anything
+    new, returning ``(carry, warm_times, converged)``.  ``converged`` is
+    False when ``max_calls`` ran out with the compile cache still growing
+    (or the timing fallback never stabilizing) — callers MUST surface it:
+    a timed window after a non-converged warm-up may still contain a
+    recompile, the exact measurement bug this helper exists to prevent.
+
+    One warm call is NOT enough for a donated-carry jit: the first call
+    compiles, and the second triggers a full recompile because the donated
+    carry comes back with executable-chosen layouts that differ from the
+    host-staged originals — a new input-layout signature.  (Round-2's
+    "5.5% MFU" was a timed window that caught that hidden 30 s+ recompile;
+    steady state measures ~9x faster.)  Steadiness is detected by the jit
+    cache size reaching a fixpoint, with a timing heuristic as fallback
+    where the private ``_cache_size`` API is unavailable; ``sync(aux)``
+    must block until the call's work is done (e.g. fetch a loss to host).
+    """
+    import time
+
+    cache_size = getattr(run, "_cache_size", lambda: None)
+    warm_times = []
+    prev_cache = -1
+    converged = False
+    for _ in range(max_calls):
+        t0 = time.perf_counter()
+        carry, aux = run(carry)
+        sync(aux)
+        warm_times.append(time.perf_counter() - t0)
+        cur_cache = cache_size()
+        if cur_cache is not None:
+            if cur_cache == prev_cache:
+                converged = True  # no compile happened this call -> steady
+                break
+            prev_cache = cur_cache
+        elif (
+            len(warm_times) >= 2
+            and warm_times[-1] == min(warm_times)
+            and abs(warm_times[-1] - warm_times[-2]) < 0.3 * warm_times[-1]
+        ):
+            converged = True
+            break
+    return carry, warm_times, converged
+
+
 def build_train_workload(n_steps: int) -> dict[str, Any]:
     """Build the benchmark training workload: a 1B-class Llama LM step
     (flash attention on TPU, AnyPrecisionAdamW, remat, bf16).
