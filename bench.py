@@ -9,7 +9,8 @@ deferred construction, then eager on-device replay materialization (bf16,
 
 Phase 2 — the other half of the BASELINE metric ("FSDP step tokens/sec/
 chip"): a 1B-class Llama train step (flash attention, AnyPrecisionAdamW,
-remat, bf16) timed over a multi-second window on the real chip (per-op
+bf16, remat off — batch 2x2048 activations fit HBM; TDX_BENCH_REMAT=1
+for shapes that don't) timed over a multi-second window on the real chip (per-op
 timings through the axon relay are unreliable — CLAUDE.md).  Reported as
 ``tokens_per_sec`` and model-FLOPs ``mfu`` in the same JSON line.
 
@@ -21,6 +22,7 @@ from __future__ import annotations
 import functools
 import json
 import math
+import os
 import resource
 import time
 
@@ -83,6 +85,7 @@ def _train_throughput():
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),
         "flash_attention": True,
+        "remat": w["remat"],  # what the workload actually built
         "optimizer": "anyprecision_adamw",
     }
 
