@@ -29,7 +29,6 @@ import time
 def _set_platform():
     # smoke-testing hook: the axon sitecustomize pins JAX_PLATFORMS, so a
     # CPU run must override via jax.config BEFORE the first device use
-    import os
 
     p = os.environ.get("TDX_BENCH_PLATFORM")
     if p:
@@ -98,8 +97,6 @@ def _materialize_7b(replay_mode: str) -> dict:
     from torchdistx_tpu._graph import RecordingSession
     from torchdistx_tpu.models import Llama
 
-    import os
-
     RecordingSession.replay_mode = replay_mode
     bench_model = os.environ.get("TDX_BENCH_MODEL", "llama2_7b")  # tiny for smoke tests
     t0 = time.time()
@@ -134,7 +131,6 @@ def _run_phase(arg: str) -> dict:
     yields a ``{"skipped": ...}`` record instead of aborting the bench, so
     one relay hiccup can never zero a whole round's evidence.
     """
-    import os
     import subprocess
     import sys
 

@@ -21,7 +21,12 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn import init as nn_init
-from ..ops.attention import cached_attention, multihead_attention, ring_attention
+from ..ops.attention import (
+    cached_attention,
+    multihead_attention,
+    ring_attention,
+    ring_flash_attention,
+)
 from ..ops.flash_attention import resolve_use_flash
 
 __all__ = ["LlamaConfig", "Llama", "llama_configs", "pp_stage"]
@@ -134,7 +139,15 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, rope, pos_offset)
         k = apply_rope(k, rope, pos_offset)
         if cfg.sp_axis is not None:
-            out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
+            if resolve_use_flash(cfg.use_flash):
+                # flash kernel per ring block: per-device memory stays
+                # flat as shards grow (8k+/shard trainable), K/V travel
+                # at hkv heads
+                out = ring_flash_attention(
+                    q, k, v, axis=cfg.sp_axis, causal=True
+                )
+            else:
+                out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
         elif resolve_use_flash(cfg.use_flash):
             from ..ops.flash_attention import flash_attention
 

@@ -13,6 +13,7 @@ by passing fewer KV heads; they are broadcast over query-head groups.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -20,7 +21,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["multihead_attention", "ring_attention", "cached_attention"]
+__all__ = [
+    "multihead_attention",
+    "ring_attention",
+    "ring_flash_attention",
+    "cached_attention",
+]
 
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -192,3 +198,278 @@ def ring_attention(
     )
     out = acc / jnp.maximum(row_sum[..., None], 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Flash-backed ring attention
+# ---------------------------------------------------------------------------
+#
+# ``ring_attention`` above computes each ring step with a full
+# (sq_local x skv_local) f32 logits matrix — fine for modest shards, but at
+# pod-scale long context (e.g. 64k over 8 devices = 8k-per-shard blocks)
+# that per-step matrix is exactly the memory wall the flash kernel exists
+# to remove.  ``ring_flash_attention`` runs the SAME ring schedule with the
+# pallas kernel per block: the kernel streams K/V through VMEM and exports
+# its per-row online-softmax state (m, l), and the ring combines blocks
+# with the standard two-level online-softmax merge.  Backward is a second
+# ring pass with the saved global LSE: dK/dV accumulators rotate WITH
+# their K/V blocks (each device adds its contribution to the block it
+# currently holds; after n hops block and gradient land home together),
+# and the per-block math is chunked over Q rows so peak memory stays
+# O(chunk x skv_local) — the flash working-set profile.
+#
+# GQA rides the kernel's native head-group mapping: K/V travel and are
+# consumed at hkv heads (the jnp ring broadcasts to hq heads inside each
+# step); gradient head-group reduction happens in the backward einsum.
+
+
+def _ring_combine(acc, m, l, raw_j, m_j, l_j):
+    """Two-level online-softmax merge: fold one block's RAW f32
+    accumulator (sum of exp(logits - m_j) @ V, not normalized — see
+    ``_flash_forward(return_residuals=True)``) and (m, l) state into the
+    running accumulator.  Pure f32 throughout; normalization happens once
+    after the last block."""
+    new_m = jnp.maximum(m, m_j)
+    alpha = jnp.exp(m - new_m)
+    beta = jnp.exp(m_j - new_m)
+    raw_j = jnp.transpose(raw_j, (0, 2, 1, 3))
+    acc = acc * alpha[..., None] + raw_j * beta[..., None]
+    return acc, new_m, l * alpha + l_j * beta
+
+
+def _ring_bwd_block(q, dout, lse, delta, kb, vb, *, diag, scale, chunk):
+    """Gradient contributions of one held K/V block, chunked over Q rows.
+
+    Explicit flash-backward formulas seeded with the GLOBAL row LSE (so
+    each block's partial softmax is exact): p = exp(logits - lse),
+    ds = p * (dout.V^T - delta) * scale, dq += ds.K, dk += ds^T.Q,
+    dv += p^T.dout.  ``delta`` = rowsum(dout * out).  ``diag`` applies the
+    local causal mask (static per cond-branch).
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = kb.shape
+    n_rep = hq // hkv
+    kb_full = _repeat_kv(kb, n_rep)
+    vb_full = _repeat_kv(vb, n_rep)
+    n_chunks = sq // chunk
+
+    def body(carry, i):
+        dk_acc, dv_acc = carry
+        qs = lax.dynamic_slice_in_dim(q, i * chunk, chunk, axis=1)
+        gs = lax.dynamic_slice_in_dim(dout, i * chunk, chunk, axis=1)
+        lse_s = lax.dynamic_slice_in_dim(lse, i * chunk, chunk, axis=2)
+        delta_s = lax.dynamic_slice_in_dim(delta, i * chunk, chunk, axis=2)
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", qs, kb_full).astype(jnp.float32)
+            * scale
+        )
+        p = jnp.exp(logits - lse_s[..., None])
+        if diag:
+            rows = i * chunk + jnp.arange(chunk)[:, None]
+            visible = jnp.arange(skv)[None, :] <= rows
+            p = jnp.where(visible[None, None], p, 0.0)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gs, vb_full).astype(jnp.float32)
+        ds = p * (dp - delta_s[..., None]) * scale
+        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, kb_full.astype(jnp.float32))
+        # per-query-head block grads, then reduce head groups for GQA
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qs.astype(jnp.float32))
+        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, gs.astype(jnp.float32))
+        if n_rep > 1:
+            dk_c = dk_c.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
+            dv_c = dv_c.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
+        return (dk_acc + dk_c, dv_acc + dv_c), dq_c
+
+    (dk, dv), dq_chunks = lax.scan(
+        body,
+        (
+            jnp.zeros(kb.shape, jnp.float32),
+            jnp.zeros(vb.shape, jnp.float32),
+        ),
+        jnp.arange(n_chunks),
+    )
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(b, sq, hq, d)
+    return dq, dk, dv
+
+
+def _ring_chunk_size(sq: int, block_q: int) -> int:
+    chunk = min(block_q, sq)
+    while chunk > 1 and sq % chunk != 0:
+        chunk //= 2
+    return chunk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash_vjp(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    from .flash_attention import _flash_forward
+
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    flash = functools.partial(
+        _flash_forward,
+        scale=scale_,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+        return_residuals=True,
+    )
+
+    def step(carry, _):
+        acc, m, l, kb, vb, j = carry
+
+        def make_branch(diag_mask):
+            def branch(ops):
+                a, mm, ll = ops
+                return _ring_combine(
+                    a, mm, ll, *flash(q, kb, vb, causal=diag_mask)
+                )
+
+            return branch
+
+        full, diag = make_branch(False), make_branch(True)
+        if causal:
+            acc, m, l = lax.cond(
+                j == idx,
+                diag,
+                lambda ops: lax.cond(j < idx, full, lambda o: o, ops),
+                (acc, m, l),
+            )
+        else:
+            acc, m, l = full((acc, m, l))
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        j = lax.ppermute(j, axis, perm)
+        return (acc, m, l, kb, vb, j), None
+
+    acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    m0 = jnp.full((b, hq, sq), jnp.float32(-1e30))
+    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    (acc, m, l, _, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v, idx), None, length=n
+    )
+    safe_l = jnp.maximum(l, 1e-30)
+    out = jnp.transpose(acc / safe_l[..., None], (0, 2, 1, 3)).astype(q.dtype)
+    lse = m + jnp.log(safe_l)  # global per-row logsumexp, saved for bwd
+    return out, lse
+
+
+def _ring_flash_fwd_rule(
+    q, k, v, axis, causal, scale, block_q, block_k, interpret
+):
+    out, lse = _ring_flash_fwd(
+        q, k, v, axis, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(
+    axis, causal, scale, block_q, block_k, interpret, res, g
+):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    chunk = _ring_chunk_size(sq, block_q)
+    # delta = rowsum(dout * out), the flash-backward correction term
+    delta = jnp.transpose(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
+        (0, 2, 1),
+    )  # (b, hq, sq)
+
+    def step(carry, _):
+        dq, kb, vb, dkb, dvb, j = carry
+
+        def make_branch(diag_mask):
+            def branch(ops):
+                dq_, dkb_, dvb_, kb_, vb_ = ops
+                dq_c, dk_c, dv_c = _ring_bwd_block(
+                    q, g, lse, delta, kb_, vb_,
+                    diag=diag_mask, scale=scale_, chunk=chunk,
+                )
+                return dq_ + dq_c, dkb_ + dk_c, dvb_ + dv_c
+
+            return branch
+
+        full, diag = make_branch(False), make_branch(True)
+        ops = (dq, dkb, dvb, kb, vb)
+        if causal:
+            dq, dkb, dvb = lax.cond(
+                j == idx,
+                diag,
+                lambda o: lax.cond(
+                    j < idx, full, lambda o_: (o_[0], o_[1], o_[2]), o
+                ),
+                ops,
+            )
+        else:
+            dq, dkb, dvb = full(ops)
+        # gradient buffers travel WITH their K/V blocks: after n hops both
+        # land back on the owning device with all contributions summed
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        dkb = lax.ppermute(dkb, axis, perm)
+        dvb = lax.ppermute(dvb, axis, perm)
+        j = lax.ppermute(j, axis, perm)
+        return (dq, kb, vb, dkb, dvb, j), None
+
+    dq0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    (dq, _, _, dk, dv, _), _ = lax.scan(
+        step, (dq0, k, v, dk0, dv0, idx), None, length=n
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash_vjp.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ring attention with the pallas flash kernel per block.
+
+    Same schedule and exact-result guarantee as :func:`ring_attention`
+    (must run inside ``shard_map`` with the sequence dim sharded over
+    ``axis``), but each ring step streams the held K/V block through the
+    flash kernel instead of materializing an (sq x skv) f32 logits
+    matrix — per-device memory stays flat as shard sizes grow, which is
+    what makes pod-scale long context (8k+ per shard) trainable.
+    Additive bias is not supported on this path (use ``ring_attention``;
+    T5's relative-position bias needs per-hop bias slicing).
+
+    Differentiable via a whole-ring custom VJP: backward is a second ring
+    pass with the saved global LSE; dK/dV accumulators rotate with their
+    blocks and the per-block math is chunked over Q rows.
+    """
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "causal ring attention requires equal per-shard query and key "
+            f"lengths, got {q.shape[1]} vs {k.shape[1]}"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _ring_flash_vjp(
+        q, k, v, axis, causal, scale, block_q, block_k, interpret
+    )

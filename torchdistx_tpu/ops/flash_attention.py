@@ -29,6 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+_RES_LANES = 128  # TPU lane width: residual (m, l) rows broadcast over it
 
 
 def _kernel(
@@ -43,12 +44,14 @@ def _kernel(
     n_k: int,
     diag_offset: int,
     has_bias: bool,
+    emit_residuals: bool = False,
 ):
-    if has_bias:
-        bias_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        bias_ref = None
-        o_ref, acc_ref, m_ref, l_ref = rest
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    o_ref = rest.pop(0)
+    m_out_ref = rest.pop(0) if emit_residuals else None
+    l_out_ref = rest.pop(0) if emit_residuals else None
+    acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -106,9 +109,24 @@ def _kernel(
 
     @pl.when(ki == n_k - 1)
     def _emit():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
-            o_ref.dtype
-        )
+        if emit_residuals:
+            # ring consumers re-scale and re-normalize across blocks:
+            # emit the RAW f32 accumulator (no divide, no output-dtype
+            # rounding — the cross-block combine stays pure f32)
+            o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+        else:
+            o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+                o_ref.dtype
+            )
+        if emit_residuals:
+            # per-row online-softmax state, consumed by ring attention's
+            # cross-block combine: m = running max, l = sum of
+            # exp(logits - m).  Stored broadcast across a 128-lane
+            # trailing dim (Mosaic requires (8, 128)-divisible or whole-
+            # array trailing block dims — the same layout jax's own TPU
+            # flash kernel uses for its lse output); callers read lane 0.
+            m_out_ref[...] = jnp.broadcast_to(m_ref[:], m_out_ref.shape)
+            l_out_ref[...] = jnp.broadcast_to(l_ref[:], l_out_ref.shape)
 
 
 @functools.partial(
@@ -262,7 +280,10 @@ def flash_attention(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret",
+        "return_residuals",
+    ),
 )
 def _flash_forward(
     q: jax.Array,
@@ -275,13 +296,23 @@ def _flash_forward(
     block_q: int = 256,
     block_k: int = 512,
     interpret: Optional[bool] = None,
-) -> jax.Array:
+    return_residuals: bool = False,
+):
     """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
 
     ``block_q``/``block_k`` are upper bounds: each is halved until it
     divides its sequence length, so any length works.  ``interpret``
     defaults to True off-TPU so the same code runs (slowly but exactly) on
     CPU platforms.
+
+    ``return_residuals=True`` additionally returns the per-row
+    online-softmax state ``(m, l)`` of shape (B, Hq, Sq) — running max and
+    sum of exp(logits - m) — which ring attention's cross-block combine
+    consumes (ops/attention.py ``ring_flash_attention``).  In that mode
+    the primary output is the RAW f32 accumulator (sum of
+    exp(logits - m) @ V, not divided by ``l``, no dtype rounding): the
+    consumer's combine re-scales blocks in pure f32 and normalizes once
+    at the end.
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -331,7 +362,24 @@ def _flash_forward(
         )
         operands.append(bias)
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0))]
+    out_shape = [
+        jax.ShapeDtypeStruct(
+            (b * hq, sq, d),
+            jnp.float32 if return_residuals else q.dtype,
+        )
+    ]
+    if return_residuals:
+        res_spec = pl.BlockSpec(
+            (None, block_q, _RES_LANES), lambda c, i, kk: (c, i, 0)
+        )
+        res_shape = jax.ShapeDtypeStruct(
+            (b * hq, sq, _RES_LANES), jnp.float32
+        )
+        out_specs += [res_spec, res_spec]
+        out_shape += [res_shape, res_shape]
+
+    outs = pl.pallas_call(
         functools.partial(
             _kernel,
             scale=scale_,
@@ -341,11 +389,12 @@ def _flash_forward(
             n_k=n_k,
             diag_offset=skv - sq,
             has_bias=bias is not None,
+            emit_residuals=return_residuals,
         ),
         grid=(b * hq, sq // block_q, n_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        out_specs=out_specs if return_residuals else out_specs[0],
+        out_shape=out_shape if return_residuals else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -356,4 +405,12 @@ def _flash_forward(
         ),
         interpret=interpret,
     )(*operands)
-    return jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    if not return_residuals:
+        return jnp.transpose(outs.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    out, m, l = outs
+    out = jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    return (
+        out,
+        m[..., 0].reshape(b, hq, sq),
+        l[..., 0].reshape(b, hq, sq),
+    )
