@@ -313,3 +313,135 @@ class TestRingFlash:
             np.asarray(logits_sp), np.asarray(logits_ref),
             atol=2e-5, rtol=1e-5,
         )
+
+
+class TestUlysses:
+    """All-to-all sequence parallelism: bit-path-identical local attention
+    after head/sequence resharding."""
+
+    @staticmethod
+    def _ulysses(mesh, causal, use_flash=False):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from torchdistx_tpu.ops.attention import ulysses_attention
+
+        return shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, axis="sp", causal=causal, use_flash=use_flash
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+
+    @pytest.mark.parametrize(
+        "hq,hkv,causal,use_flash",
+        [
+            (8, 8, True, False),
+            (16, 8, True, False),  # GQA (both divisible by 8)
+            (8, 8, False, False),
+            (8, 8, True, True),  # flash local attention (interpret)
+        ],
+    )
+    def test_matches_full_attention(self, hq, hkv, causal, use_flash):
+        from torchdistx_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"sp": 8})
+        rng = np.random.RandomState(1)
+        b, s, d = 2, 64, 8
+        q = jnp.asarray(rng.randn(b, s, hq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        out = self._ulysses(mesh, causal, use_flash)(q, k, v)
+        ref = multihead_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_gradients_match_full_attention(self):
+        from torchdistx_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"sp": 8})
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
+        uly = self._ulysses(mesh, True)
+
+        g = jax.grad(
+            lambda a, b_, c: jnp.sum(jnp.sin(uly(a, b_, c))),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda a, b_, c: jnp.sum(
+                jnp.sin(multihead_attention(a, b_, c, causal=True))
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-5
+            )
+
+    def test_indivisible_heads_rejected(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from torchdistx_tpu.ops.attention import ulysses_attention
+        from torchdistx_tpu.parallel import create_mesh
+
+        q = jnp.zeros((1, 8, 6, 8))  # 6 heads, axis of 8
+        mesh = create_mesh({"sp": 8})
+        f = shard_map(
+            lambda a: ulysses_attention(a, a, a, axis="sp"),
+            mesh=mesh,
+            in_specs=P(None, "sp"),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            f(q)
+
+    def test_llama_sp_mode_ulysses_matches_single_device(self):
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torchdistx_tpu.nn.module import functional_call
+        from torchdistx_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"sp": 8})
+        tdx.manual_seed(4)
+        m_sp = tdx.deferred_init(
+            Llama.from_name, "tiny", max_seq_len=64,
+            sp_axis="sp", sp_mode="ulysses", n_heads=8, dim=64,
+        )
+        tdx.materialize_module(m_sp)
+        params = jax.device_put(
+            dict(m_sp.named_parameters()), NamedSharding(mesh, P())
+        )
+        tdx.manual_seed(4)
+        m_ref = tdx.deferred_init(
+            Llama.from_name, "tiny", max_seq_len=64, n_heads=8, dim=64,
+        )
+        tdx.materialize_module(m_ref)
+
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (2, 64)), jnp.int32
+        )
+        logits_sp = shard_map(
+            lambda t: functional_call(m_sp, params, (t,)),
+            mesh=mesh,
+            in_specs=P(None, "sp"),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )(tokens)
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(m_ref(tokens)),
+            atol=2e-5, rtol=1e-5,
+        )
+
+    def test_bad_sp_mode_rejected(self):
+        with pytest.raises(ValueError, match="sp_mode"):
+            Llama.from_name("tiny", sp_mode="spiral")

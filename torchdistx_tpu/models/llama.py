@@ -26,6 +26,7 @@ from ..ops.attention import (
     multihead_attention,
     ring_attention,
     ring_flash_attention,
+    ulysses_attention,
 )
 from ..ops.flash_attention import resolve_use_flash
 
@@ -45,7 +46,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block
-    sp_axis: Optional[str] = None  # ring attention over this mesh axis
+    sp_axis: Optional[str] = None  # sequence parallelism over this mesh axis
+    # "ring" (K/V rotate, works for any head count, O(S)-bias support) or
+    # "ulysses" (two all-to-alls around local attention; needs head counts
+    # divisible by the axis size)
+    sp_mode: str = "ring"
     # pallas flash-attention kernel (single chip).  None = auto: on for TPU
     # (measured 2-5x over the jnp path at 2k-4k and the only path that runs
     # at 8k+, scripts/bench_flash_attention.py), off elsewhere (the CPU
@@ -53,6 +58,10 @@ class LlamaConfig:
     use_flash: Optional[bool] = None
 
     def __post_init__(self) -> None:
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got {self.sp_mode!r}"
+            )
         if self.n_kv_heads is None:
             self.n_kv_heads = self.n_heads
         if self.ffn_dim is None:
@@ -139,7 +148,12 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, rope, pos_offset)
         k = apply_rope(k, rope, pos_offset)
         if cfg.sp_axis is not None:
-            if resolve_use_flash(cfg.use_flash):
+            if cfg.sp_mode == "ulysses":
+                out = ulysses_attention(
+                    q, k, v, axis=cfg.sp_axis, causal=True,
+                    use_flash=cfg.use_flash,
+                )
+            elif resolve_use_flash(cfg.use_flash):
                 # flash kernel per ring block: per-device memory stays
                 # flat as shards grow (8k+/shard trainable), K/V travel
                 # at hkv heads

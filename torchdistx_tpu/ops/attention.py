@@ -25,6 +25,7 @@ __all__ = [
     "multihead_attention",
     "ring_attention",
     "ring_flash_attention",
+    "ulysses_attention",
     "cached_attention",
 ]
 
@@ -473,3 +474,55 @@ def ring_flash_attention(
     return _ring_flash_vjp(
         q, k, v, axis, causal, scale, block_q, block_k, interpret
     )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: the
+    other standard long-context strategy next to :func:`ring_attention`.
+
+    Inside ``shard_map`` with the sequence dim sharded over ``axis``:
+    one all-to-all reshards (seq-sharded, all heads) -> (full seq,
+    heads/n), attention runs LOCALLY over the full sequence with the
+    head slice (the flash kernel when available — composes for free,
+    since post-reshard attention is ordinary single-device attention),
+    and a second all-to-all reshards back.  Communication is 2
+    all-to-alls of O(S*D/n) per device versus the ring's n ppermute
+    hops; attention math is bit-identical to the unsharded computation
+    (no online-softmax recombination at all).
+
+    Requires query AND kv head counts divisible by the axis size (GQA
+    works when ``hkv % n == 0``); prefer the ring for very wide-group
+    GQA or head counts that don't divide.
+    """
+    n = lax.axis_size(axis)
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    if hq % n != 0 or hkv % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs head counts divisible by the axis "
+            f"size: hq={hq}, hkv={hkv}, |{axis}|={n} — use ring attention "
+            "for non-dividing head counts"
+        )
+    # (b, s/n, h, d) -> (b, s, h/n, d): split heads, concat sequence
+    qg = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    from .flash_attention import resolve_use_flash
+
+    if resolve_use_flash(use_flash):
+        from .flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    else:
+        out = multihead_attention(qg, kg, vg, causal=causal, scale=scale)
+    # inverse reshard: (b, s, h/n, d) -> (b, s/n, h, d)
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
