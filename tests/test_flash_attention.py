@@ -360,14 +360,15 @@ class TestUlysses:
             np.asarray(out), np.asarray(ref), atol=2e-5
         )
 
-    def test_gradients_match_full_attention(self):
+    @pytest.mark.parametrize("hq,hkv", [(8, 8), (16, 8)])
+    def test_gradients_match_full_attention(self, hq, hkv):
         from torchdistx_tpu.parallel import create_mesh
 
         mesh = create_mesh({"sp": 8})
         rng = np.random.RandomState(2)
-        q = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
-        k = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
-        v = jnp.asarray(rng.randn(1, 64, 8, 8), jnp.float32)
+        q = jnp.asarray(rng.randn(1, 64, hq, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 64, hkv, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 64, hkv, 8), jnp.float32)
         uly = self._ulysses(mesh, True)
 
         g = jax.grad(
