@@ -71,20 +71,30 @@ def test_gqa_head_mismatch_error():
         flash_attention(q, k, k)
 
 
-@pytest.mark.parametrize("hq,hkv", [(2, 2), (8, 2)])
-def test_gradients_match_reference(hq, hkv):
-    # flash fwd + chunked-recompute bwd must give the reference's
-    # gradients, incl. the GQA dK/dV group reduction
+@pytest.mark.parametrize(
+    "hq,hkv,sq,skv,causal",
+    [
+        (2, 2, 64, 64, True),
+        (8, 2, 64, 64, True),  # GQA dK/dV group reduction
+        (2, 2, 32, 64, True),  # Sq < Skv: end-aligned diag_offset masking
+        (2, 2, 64, 64, False),  # non-causal (cross-attention shapes)
+    ],
+)
+def test_gradients_match_reference(hq, hkv, sq, skv, causal):
+    # flash fwd + pallas FA2 bwd must give the reference's gradients
+    # across every masking regime the backward kernels implement
     rs = np.random.RandomState(7)
-    q = jnp.asarray(rs.randn(1, 64, hq, 16), jnp.float32)
-    k = jnp.asarray(rs.randn(1, 64, hkv, 16), jnp.float32)
-    v = jnp.asarray(rs.randn(1, 64, hkv, 16), jnp.float32)
+    q = jnp.asarray(rs.randn(1, sq, hq, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(1, skv, hkv, 16), jnp.float32)
+    v = jnp.asarray(rs.randn(1, skv, hkv, 16), jnp.float32)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=32) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=32) ** 2
+        )
 
     def loss_ref(q, k, v):
-        return jnp.sum(multihead_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(multihead_attention(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)

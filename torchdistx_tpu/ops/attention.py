@@ -216,8 +216,9 @@ def ring_attention(
 # ring pass with the saved global LSE: dK/dV accumulators rotate WITH
 # their K/V blocks (each device adds its contribution to the block it
 # currently holds; after n hops block and gradient land home together),
-# and the per-block math is chunked over Q rows so peak memory stays
-# O(chunk x skv_local) — the flash working-set profile.
+# and the per-block math runs through the pallas FlashAttention-2
+# backward kernels seeded with the global LSE — VMEM-blocked like the
+# forward, no per-hop logits matrix.
 #
 # GQA rides the kernel's native head-group mapping: K/V travel and are
 # consumed at hkv heads (the jnp ring broadcasts to hq heads inside each
@@ -238,65 +239,39 @@ def _ring_combine(acc, m, l, raw_j, m_j, l_j):
     return acc, new_m, l * alpha + l_j * beta
 
 
-def _ring_bwd_block(q, dout, lse, delta, kb, vb, *, diag, scale, chunk):
-    """Gradient contributions of one held K/V block, chunked over Q rows.
+def _ring_bwd_block(
+    prep, khb, vhb, *, b, hq, hkv, diag, scale, block_q, block_k, interpret
+):
+    """Gradient contributions of one held K/V block, via the pallas
+    FlashAttention-2 backward kernels seeded with the GLOBAL row LSE —
+    each block's partial softmax ``p = exp(logits - lse)`` is then exact,
+    so the kernel outputs are this block's exact gradient contributions
+    (``_flash_backward`` docstring).  ``prep`` is the hoisted
+    loop-invariant operand tuple (``_prepare_flash_bwd``); K/V arrive and
+    gradients leave HEAD-MAJOR, matching the ring carry.  ``diag``
+    applies the local causal mask (static per cond-branch); contributions
+    accumulate across hops in f32."""
+    from .flash_attention import _flash_backward_core
 
-    Explicit flash-backward formulas seeded with the GLOBAL row LSE (so
-    each block's partial softmax is exact): p = exp(logits - lse),
-    ds = p * (dout.V^T - delta) * scale, dq += ds.K, dk += ds^T.Q,
-    dv += p^T.dout.  ``delta`` = rowsum(dout * out).  ``diag`` applies the
-    local causal mask (static per cond-branch).
-    """
-    b, sq, hq, d = q.shape
-    _, skv, hkv, _ = kb.shape
-    n_rep = hq // hkv
-    kb_full = _repeat_kv(kb, n_rep)
-    vb_full = _repeat_kv(vb, n_rep)
-    n_chunks = sq // chunk
-
-    def body(carry, i):
-        dk_acc, dv_acc = carry
-        qs = lax.dynamic_slice_in_dim(q, i * chunk, chunk, axis=1)
-        gs = lax.dynamic_slice_in_dim(dout, i * chunk, chunk, axis=1)
-        lse_s = lax.dynamic_slice_in_dim(lse, i * chunk, chunk, axis=2)
-        delta_s = lax.dynamic_slice_in_dim(delta, i * chunk, chunk, axis=2)
-        logits = (
-            jnp.einsum("bqhd,bkhd->bhqk", qs, kb_full).astype(jnp.float32)
-            * scale
-        )
-        p = jnp.exp(logits - lse_s[..., None])
-        if diag:
-            rows = i * chunk + jnp.arange(chunk)[:, None]
-            visible = jnp.arange(skv)[None, :] <= rows
-            p = jnp.where(visible[None, None], p, 0.0)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", gs, vb_full).astype(jnp.float32)
-        ds = p * (dp - delta_s[..., None]) * scale
-        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, kb_full.astype(jnp.float32))
-        # per-query-head block grads, then reduce head groups for GQA
-        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qs.astype(jnp.float32))
-        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, gs.astype(jnp.float32))
-        if n_rep > 1:
-            dk_c = dk_c.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
-            dv_c = dv_c.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
-        return (dk_acc + dk_c, dv_acc + dv_c), dq_c
-
-    (dk, dv), dq_chunks = lax.scan(
-        body,
-        (
-            jnp.zeros(kb.shape, jnp.float32),
-            jnp.zeros(vb.shape, jnp.float32),
-        ),
-        jnp.arange(n_chunks),
+    qh, doh, oh, lse_b = prep
+    dqh, dk_part, dv_part = _flash_backward_core(
+        qh, doh, oh, lse_b, khb, vhb,
+        b=b, hq=hq, hkv=hkv,
+        causal=diag, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        dq_dtype=jnp.float32, part_dtype=jnp.float32,
     )
-    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(b, sq, hq, d)
-    return dq, dk, dv
-
-
-def _ring_chunk_size(sq: int, block_q: int) -> int:
-    chunk = min(block_q, sq)
-    while chunk > 1 and sq % chunk != 0:
-        chunk //= 2
-    return chunk
+    n_rep = hq // hkv
+    if n_rep > 1:
+        # fold per-query-head partials onto the kv heads (g-major groups)
+        skv, d = dk_part.shape[1:]
+        dk_part = (
+            dk_part.reshape(b, hkv, n_rep, skv, d).sum(2).reshape(-1, skv, d)
+        )
+        dv_part = (
+            dv_part.reshape(b, hkv, n_rep, skv, d).sum(2).reshape(-1, skv, d)
+        )
+    return dqh, dk_part, dv_part
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -377,17 +352,22 @@ def _ring_flash_bwd_rule(
     axis, causal, scale, block_q, block_k, interpret, res, g
 ):
     q, k, v, out, lse = res
+    from .flash_attention import _prepare_flash_bwd
+
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    chunk = _ring_chunk_size(sq, block_q)
-    # delta = rowsum(dout * out), the flash-backward correction term
-    delta = jnp.transpose(
-        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
-        (0, 2, 1),
-    )  # (b, hq, sq)
+
+    # loop-invariant operands hoisted out of the ring: transposes + the
+    # lse lane-broadcast happen once, not per hop
+    prep = _prepare_flash_bwd(q, g, out, lse)
+    # K/V and their gradient accumulators travel the ring HEAD-MAJOR (the
+    # kernels' layout) so hops carry no per-step transposes either
+    kh = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
+    vh = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
 
     def step(carry, _):
         dq, kb, vb, dkb, dvb, j = carry
@@ -396,8 +376,10 @@ def _ring_flash_bwd_rule(
             def branch(ops):
                 dq_, dkb_, dvb_, kb_, vb_ = ops
                 dq_c, dk_c, dv_c = _ring_bwd_block(
-                    q, g, lse, delta, kb_, vb_,
-                    diag=diag_mask, scale=scale_, chunk=chunk,
+                    prep, kb_, vb_,
+                    b=b, hq=hq, hkv=hkv,
+                    diag=diag_mask, scale=scale_,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
                 )
                 return dq_ + dq_c, dkb_ + dk_c, dvb_ + dv_c
 
@@ -425,12 +407,15 @@ def _ring_flash_bwd_rule(
         j = lax.ppermute(j, axis, perm)
         return (dq, kb, vb, dkb, dvb, j), None
 
-    dq0 = jnp.zeros((b, sq, hq, d), jnp.float32)
-    dk0 = jnp.zeros(k.shape, jnp.float32)
-    dv0 = jnp.zeros(v.shape, jnp.float32)
-    (dq, _, _, dk, dv, _), _ = lax.scan(
-        step, (dq0, k, v, dk0, dv0, idx), None, length=n
+    dq0 = jnp.zeros((b * hq, sq, d), jnp.float32)
+    dk0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
+    dv0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
+    (dqh, _, _, dkh, dvh, _), _ = lax.scan(
+        step, (dq0, kh, vh, dk0, dv0, idx), None, length=n
     )
+    dq = jnp.transpose(dqh.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    dk = jnp.transpose(dkh.reshape(b, hkv, skv, d), (0, 2, 1, 3))
+    dv = jnp.transpose(dvh.reshape(b, hkv, skv, d), (0, 2, 1, 3))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -462,7 +447,8 @@ def ring_flash_attention(
 
     Differentiable via a whole-ring custom VJP: backward is a second ring
     pass with the saved global LSE; dK/dV accumulators rotate with their
-    blocks and the per-block math is chunked over Q rows.
+    blocks and each block's contributions come from the pallas
+    FlashAttention-2 backward kernels (``_flash_backward``).
     """
     if causal and q.shape[1] != k.shape[1]:
         raise ValueError(

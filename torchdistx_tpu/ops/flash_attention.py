@@ -32,6 +32,15 @@ _NEG_INF = -1e30
 _RES_LANES = 128  # TPU lane width: residual (m, l) rows broadcast over it
 
 
+def _shrink_block(block: int, s: int) -> int:
+    """Halve ``block`` until it divides ``s`` (upper-bound semantics shared
+    by the forward and both backwards — one policy, one place)."""
+    block = min(block, s)
+    while block > 1 and s % block != 0:
+        block //= 2
+    return block
+
+
 def _kernel(
     q_ref,
     k_ref,
@@ -45,12 +54,14 @@ def _kernel(
     diag_offset: int,
     has_bias: bool,
     emit_residuals: bool = False,
+    emit_lse: bool = False,
 ):
     rest = list(rest)
     bias_ref = rest.pop(0) if has_bias else None
     o_ref = rest.pop(0)
     m_out_ref = rest.pop(0) if emit_residuals else None
     l_out_ref = rest.pop(0) if emit_residuals else None
+    lse_out_ref = rest.pop(0) if emit_lse else None
     acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -127,6 +138,350 @@ def _kernel(
             # flash kernel uses for its lse output); callers read lane 0.
             m_out_ref[...] = jnp.broadcast_to(m_ref[:], m_out_ref.shape)
             l_out_ref[...] = jnp.broadcast_to(l_ref[:], l_out_ref.shape)
+        if emit_lse:
+            # log-sum-exp per row, consumed by the pallas backward: it
+            # reconstitutes probabilities as exp(logits - lse) without an
+            # online max.  Same broadcast-lane layout as the residuals.
+            lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+            lse_out_ref[...] = jnp.broadcast_to(lse, lse_out_ref.shape)
+
+
+def _bwd_dkv_kernel(
+    q_ref,
+    do_ref,
+    o_ref,
+    lse_ref,
+    k_ref,
+    v_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc,
+    dv_acc,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_q: int,
+    diag_offset: int,
+):
+    """Grid (b*hq, n_k, n_q): each program owns one K/V block and streams
+    Q blocks (innermost, sequential), accumulating dK/dV in VMEM —
+    FlashAttention-2 backward, K/V-stationary half.
+
+    ``delta = rowsum(dO * O)`` is computed IN-kernel from the O block (a
+    cheap VPU rowsum) rather than precomputed: an O block is half the HBM
+    bytes of a 128-lane-broadcast f32 delta block, and nothing gets
+    materialized.  (Only lse still needs the broadcast-lane input
+    layout: 1D-row-block and trailing-1 layouts were probed on hardware
+    but the probes hit a device-relay outage — re-probe before assuming
+    Mosaic accepts them.)"""
+    kk = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        # skip Q blocks whose every row precedes this K block entirely
+        any_visible = kk * block_k <= (
+            qi * block_q + block_q - 1 + diag_offset
+        )
+    else:
+        any_visible = jnp.ones((), bool)
+
+    @pl.when(any_visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)  # (block_q, d)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]  # (block_q, 1)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k)
+        p = jnp.exp(logits - lse)
+        if causal:
+            rows = (
+                qi * block_q
+                + diag_offset
+                + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            )
+            cols = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, p.shape, 1
+            )
+            p = jnp.where(cols <= rows, p, 0.0)
+        # dV += P^T dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dS = P * (dO V^T - delta) * scale;  dK += dS^T Q
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref,
+    do_ref,
+    o_ref,
+    lse_ref,
+    k_ref,
+    v_ref,
+    dq_ref,
+    dq_acc,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+    diag_offset: int,
+):
+    """Grid (b*hq, n_q, n_k): each program owns one Q block and streams
+    K/V blocks — Q-stationary half, same schedule as the forward.
+    ``delta`` in-kernel as in ``_bwd_dkv_kernel``."""
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        any_visible = kk * block_k <= (
+            qi * block_q + block_q - 1 + diag_offset
+        )
+    else:
+        any_visible = jnp.ones((), bool)
+
+    @pl.when(any_visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        p = jnp.exp(logits - lse)
+        if causal:
+            rows = (
+                qi * block_q
+                + diag_offset
+                + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            )
+            cols = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, p.shape, 1
+            )
+            p = jnp.where(cols <= rows, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, *, causal, scale, block_q, block_k, interpret,
+    grad_dtype=None,
+):
+    """Pallas FlashAttention-2 backward (bias-free path): two kernels —
+    K/V-stationary for dK/dV and Q-stationary for dQ — reconstructing
+    probabilities from the saved lse, with ``delta = rowsum(dO * O)``
+    computed in-kernel.  HBM traffic is O(S*D) per head like the forward; the
+    chunked-recompute fallback (``_flash_bwd_rule``) re-ran the whole
+    fused-XLA attention per chunk and measured ~2.8x slower per layer on
+    the llama_1b bench step (43 ms/step of 210 at seq 2048 — trace,
+    round 3).
+
+    ``lse`` may come from a LARGER softmax than this K/V block (ring
+    attention seeds the global row LSE): probabilities then come out
+    partial-but-exact, making the outputs this block's exact gradient
+    contributions.  ``grad_dtype`` overrides the output dtypes (the ring
+    accumulates block contributions across hops in f32)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    n_rep = hq // hkv
+    dkv_dtype = grad_dtype or k.dtype
+    dq_dtype = grad_dtype or q.dtype
+
+    qh, doh, oh, lse_b = _prepare_flash_bwd(q, g, out, lse)
+    kh = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
+    vh = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
+
+    dq, dk_part, dv_part = _flash_backward_core(
+        qh, doh, oh, lse_b, kh, vh,
+        b=b, hq=hq, hkv=hkv,
+        causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        dq_dtype=dq_dtype,
+        part_dtype=jnp.float32 if n_rep > 1 else dkv_dtype,
+    )
+
+    dq = jnp.transpose(dq.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    # heads are grouped g-major (h = g * n_rep + r), so GQA partials fold
+    # with one reshape-sum
+    dk = jnp.transpose(
+        dk_part.reshape(b, hkv, n_rep, skv, d).sum(axis=2).astype(dkv_dtype),
+        (0, 2, 1, 3),
+    )
+    dv = jnp.transpose(
+        dv_part.reshape(b, hkv, n_rep, skv, d).sum(axis=2).astype(dkv_dtype),
+        (0, 2, 1, 3),
+    )
+    return dq, dk, dv
+
+
+def _prepare_flash_bwd(q, g, out, lse):
+    """Loop-invariant backward operands, head-major: callers that invoke
+    the core repeatedly against rotating K/V blocks (ring attention) hoist
+    this out of their loop.  Only lse needs the 128-lane broadcast
+    layout (the forward's proven residual layout; slimmer layouts are
+    unproven here — see _bwd_dkv_kernel); delta is computed in-kernel
+    from the O blocks."""
+    b, sq, hq, d = q.shape
+    qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * hq, sq, d)
+    doh = jnp.transpose(g, (0, 2, 1, 3)).reshape(b * hq, sq, d)
+    oh = jnp.transpose(out, (0, 2, 1, 3)).reshape(b * hq, sq, d)
+    lse_b = jnp.broadcast_to(
+        lse.reshape(b * hq, sq)[:, :, None], (b * hq, sq, _RES_LANES)
+    )
+    return qh, doh, oh, lse_b
+
+
+def _flash_backward_core(
+    qh, doh, oh, lse_b, kh, vh, *,
+    b, hq, hkv, causal, scale, block_q, block_k, interpret,
+    dq_dtype, part_dtype,
+):
+    """The two backward pallas calls over head-major operands (see
+    ``_flash_backward``).  Returns head-major ``(dq, dk_part, dv_part)``
+    with dK/dV as per-QUERY-head partials (callers fold GQA groups)."""
+    _, sq, d = qh.shape
+    skv = kh.shape[1]
+    n_rep = hq // hkv
+    block_q = _shrink_block(block_q, sq)
+    block_k = _shrink_block(block_k, skv)
+    n_q, n_k = sq // block_q, skv // block_k
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    diag_offset = skv - sq
+
+    def kv_index(c, kk, qi=None):
+        return (c // hq) * hkv + (c % hq) // n_rep, kk, 0
+
+    # dK/dV: K/V-stationary, Q innermost
+    q_spec = pl.BlockSpec((1, block_q, d), lambda c, kk, qi: (c, qi, 0))
+    res_spec = pl.BlockSpec(
+        (None, block_q, _RES_LANES), lambda c, kk, qi: (c, qi, 0)
+    )
+    dkv_in_specs = [
+        q_spec,
+        q_spec,
+        q_spec,
+        res_spec,
+        pl.BlockSpec((1, block_k, d), lambda c, kk, qi: kv_index(c, kk)),
+        pl.BlockSpec((1, block_k, d), lambda c, kk, qi: kv_index(c, kk)),
+    ]
+    dkv_out_spec = pl.BlockSpec((1, block_k, d), lambda c, kk, qi: (c, kk, 0))
+    dk_part, dv_part = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale_,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            n_q=n_q,
+            diag_offset=diag_offset,
+        ),
+        grid=(b * hq, n_k, n_q),
+        in_specs=dkv_in_specs,
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, skv, d), part_dtype),
+            jax.ShapeDtypeStruct((b * hq, skv, d), part_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qh, doh, oh, lse_b, kh, vh)
+
+    # dQ: Q-stationary, K/V innermost (the forward's schedule)
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda c, qi, kk: (c, qi, 0))
+    res_spec2 = pl.BlockSpec(
+        (None, block_q, _RES_LANES), lambda c, qi, kk: (c, qi, 0)
+    )
+    dq_in_specs = [
+        q_spec2,
+        q_spec2,
+        q_spec2,
+        res_spec2,
+        pl.BlockSpec((1, block_k, d), lambda c, qi, kk: kv_index(c, kk)),
+        pl.BlockSpec((1, block_k, d), lambda c, qi, kk: kv_index(c, kk)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale_,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            n_k=n_k,
+            diag_offset=diag_offset,
+        ),
+        grid=(b * hq, n_q, n_k),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda c, qi, kk: (c, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), dq_dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qh, doh, oh, lse_b, kh, vh)
+    return dq, dk_part, dv_part
 
 
 @functools.partial(
@@ -149,6 +504,21 @@ def _flash_attention_vjp(
 
 
 def _flash_fwd_rule(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    if bias is None:
+        # pallas backward path: save the output + per-row lse instead of
+        # recomputing the softmax state chunk by chunk
+        out, lse = _flash_forward(
+            q,
+            k,
+            v,
+            causal=causal,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+            return_lse=True,
+        )
+        return out, (q, k, v, None, out, lse)
     out = _flash_forward(
         q,
         k,
@@ -160,7 +530,7 @@ def _flash_fwd_rule(q, k, v, bias, causal, scale, block_q, block_k, interpret):
         block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v, bias)
+    return out, (q, k, v, bias, None, None)
 
 
 def _attention_chunk(qc, k, v, bias_rows, row_offset, causal, scale):
@@ -187,42 +557,51 @@ def _attention_chunk(qc, k, v, bias_rows, row_offset, causal, scale):
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
-    # Backward by CHUNKED recomputation: pallas_call has no autodiff rule,
-    # so each Q chunk's attention is recomputed with XLA and differentiated
-    # via jax.vjp, accumulating dK/dV across chunks under lax.scan.  Peak
+    q, k, v, bias, out, lse = res
+    if bias is None:
+        # pallas FlashAttention-2 backward (see _flash_backward)
+        dq, dk, dv = _flash_backward(
+            q, k, v, out, lse, g,
+            causal=causal,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+        )
+        return dq, dk, dv, None
+    return _flash_bwd_chunked(
+        q, k, v, bias, g, causal, scale, block_q
+    )
+
+
+def _flash_bwd_chunked(q, k, v, bias, g, causal, scale, block_q):
+    # Backward by CHUNKED recomputation — the bias (dbias) path: each Q
+    # chunk's attention is recomputed with XLA and differentiated via
+    # jax.vjp, accumulating dK/dV across chunks under lax.scan.  Peak
     # memory is O(chunk * Skv) — the flash working-set profile — instead of
-    # the O(Sq * Skv) a whole-matrix recompute would allocate.
-    q, k, v, bias = res
+    # the O(Sq * Skv) a whole-matrix recompute would allocate.  (dbias is
+    # itself O(Sq * Skv) per head, so the pallas backward's traffic
+    # advantage is moot here; bias-free callers take _flash_backward.)
     b, sq, hq, d = q.shape
     _, skv, _, _ = k.shape
-    chunk = min(block_q, sq)
-    while chunk > 1 and sq % chunk != 0:
-        chunk //= 2
+    chunk = _shrink_block(block_q, sq)
     n_chunks = sq // chunk
     diag_offset = skv - sq
-
-    has_bias = bias is not None
 
     def body(carry, idx):
         dk_acc, dv_acc = carry
         qs = jax.lax.dynamic_slice_in_dim(q, idx * chunk, chunk, axis=1)
         gs = jax.lax.dynamic_slice_in_dim(g, idx * chunk, chunk, axis=1)
         row_offset = idx * chunk + diag_offset
-        operands = (qs, k, v) + (
-            (jax.lax.dynamic_slice_in_dim(bias, idx * chunk, chunk, axis=1),)
-            if has_bias
-            else ()
-        )
+        bs = jax.lax.dynamic_slice_in_dim(bias, idx * chunk, chunk, axis=1)
 
-        def chunk_fn(q_, k_, v_, *b_):
+        def chunk_fn(q_, k_, v_, b_):
             return _attention_chunk(
-                q_, k_, v_, b_[0] if b_ else None, row_offset, causal, scale
+                q_, k_, v_, b_, row_offset, causal, scale
             )
 
-        _, vjp = jax.vjp(chunk_fn, *operands)
-        grads = vjp(gs)
-        dq_c, dk_c, dv_c = grads[:3]
-        db_c = grads[3] if has_bias else jnp.zeros((), jnp.float32)
+        _, vjp = jax.vjp(chunk_fn, qs, k, v, bs)
+        dq_c, dk_c, dv_c, db_c = vjp(gs)
         return (dk_acc + dk_c, dv_acc + dv_c), (dq_c, db_c)
 
     (dk, dv), (dq_chunks, db_chunks) = jax.lax.scan(
@@ -232,8 +611,6 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     )
     # (n_chunks, B, chunk, H, D) -> (B, Sq, H, D)
     dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(b, sq, hq, d)
-    if bias is None:
-        return dq, dk, dv, None
     # (n_chunks, H, chunk, Skv) -> (H, Sq, Skv)
     dbias = jnp.moveaxis(db_chunks, 0, 1).reshape(hq, sq, skv).astype(bias.dtype)
     return dq, dk, dv, dbias
@@ -264,8 +641,11 @@ def flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Differentiable entry point: flash kernel forward, recomputed
-    reference backward (see ``_flash_bwd_rule``).
+    """Differentiable entry point: flash kernel forward; the backward is
+    the pallas FlashAttention-2 kernel pair (``_flash_backward``) on the
+    bias-free path — residuals are the output and per-row lse, NOT a
+    recompute — and chunked XLA recomputation (``_flash_bwd_chunked``)
+    when ``bias`` is given.
 
     ``bias``: optional additive logit bias of shape (Hq, Sq, Skv), shared
     across the batch — T5's relative-position bias.  Streamed blockwise
@@ -282,7 +662,7 @@ def flash_attention(
     jax.jit,
     static_argnames=(
         "causal", "scale", "block_q", "block_k", "interpret",
-        "return_residuals",
+        "return_residuals", "return_lse",
     ),
 )
 def _flash_forward(
@@ -297,6 +677,7 @@ def _flash_forward(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     return_residuals: bool = False,
+    return_lse: bool = False,
 ):
     """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
 
@@ -313,7 +694,13 @@ def _flash_forward(
     exp(logits - m) @ V, not divided by ``l``, no dtype rounding): the
     consumer's combine re-scales blocks in pure f32 and normalizes once
     at the end.
+
+    ``return_lse=True`` (exclusive with ``return_residuals``) returns the
+    NORMALIZED output plus per-row ``lse = m + log(l)`` of shape
+    (B, Hq, Sq) — the residual the pallas backward consumes.
     """
+    if return_residuals and return_lse:
+        raise ValueError("return_residuals and return_lse are exclusive")
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if hq % hkv != 0:
@@ -325,12 +712,8 @@ def _flash_forward(
             f"causal attention requires Sq ({sq}) <= Skv ({skv})"
         )
     n_rep = hq // hkv
-    block_q = min(block_q, sq)
-    while block_q > 1 and sq % block_q != 0:
-        block_q //= 2
-    block_k = min(block_k, skv)
-    while block_k > 1 and skv % block_k != 0:
-        block_k //= 2
+    block_q = _shrink_block(block_q, sq)
+    block_k = _shrink_block(block_k, skv)
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -369,15 +752,20 @@ def _flash_forward(
             jnp.float32 if return_residuals else q.dtype,
         )
     ]
-    if return_residuals:
+    multi_out = return_residuals or return_lse
+    if multi_out:
         res_spec = pl.BlockSpec(
             (None, block_q, _RES_LANES), lambda c, i, kk: (c, i, 0)
         )
         res_shape = jax.ShapeDtypeStruct(
             (b * hq, sq, _RES_LANES), jnp.float32
         )
-        out_specs += [res_spec, res_spec]
-        out_shape += [res_shape, res_shape]
+        if return_residuals:
+            out_specs += [res_spec, res_spec]
+            out_shape += [res_shape, res_shape]
+        else:
+            out_specs += [res_spec]
+            out_shape += [res_shape]
 
     outs = pl.pallas_call(
         functools.partial(
@@ -390,11 +778,12 @@ def _flash_forward(
             diag_offset=skv - sq,
             has_bias=bias is not None,
             emit_residuals=return_residuals,
+            emit_lse=return_lse,
         ),
         grid=(b * hq, sq // block_q, n_k),
         in_specs=in_specs,
-        out_specs=out_specs if return_residuals else out_specs[0],
-        out_shape=out_shape if return_residuals else out_shape[0],
+        out_specs=out_specs if multi_out else out_specs[0],
+        out_shape=out_shape if multi_out else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -405,8 +794,12 @@ def _flash_forward(
         ),
         interpret=interpret,
     )(*operands)
-    if not return_residuals:
+    if not multi_out:
         return jnp.transpose(outs.reshape(b, hq, sq, d), (0, 2, 1, 3))
+    if return_lse:
+        out, lse = outs
+        out = jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
+        return out, lse[..., 0].reshape(b, hq, sq)
     out, m, l = outs
     out = jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))
     return (
