@@ -1,5 +1,6 @@
 from .gpt2 import GPT2, GPT2Config, gpt2_configs
 from .llama import Llama, LlamaConfig, llama_configs
+from .mixtral import Mixtral, MixtralConfig, mixtral_configs
 from .resnet import ResNet, resnet18, resnet50, resnet101
 from .t5 import T5, T5Config, t5_configs
 
@@ -7,6 +8,9 @@ __all__ = [
     "Llama",
     "LlamaConfig",
     "llama_configs",
+    "Mixtral",
+    "MixtralConfig",
+    "mixtral_configs",
     "GPT2",
     "GPT2Config",
     "gpt2_configs",

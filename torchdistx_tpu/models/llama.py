@@ -204,12 +204,14 @@ class LlamaMLP(nn.Module):
 
 
 class LlamaBlock(nn.Module):
-    def __init__(self, cfg: LlamaConfig):
+    def __init__(self, cfg: LlamaConfig, mlp: Optional[nn.Module] = None):
         super().__init__()
         self.attn_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
         self.attn = LlamaAttention(cfg)
         self.mlp_norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
-        self.mlp = LlamaMLP(cfg)
+        # the FFN half is pluggable: Mixtral's block passes an MoE here and
+        # inherits the whole attention/cache scaffolding
+        self.mlp = mlp if mlp is not None else LlamaMLP(cfg)
 
     def forward(self, x, rope):
         x = x + self.attn(self.attn_norm(x), rope)
@@ -224,13 +226,17 @@ class LlamaBlock(nn.Module):
 
 
 class Llama(nn.Module):
+    block_cls = LlamaBlock  # subclasses (Mixtral) swap the block type
+
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
         self.tok_emb = nn.Embedding(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, weight_init=_hf_normal
         )
-        self.blocks = nn.ModuleList([LlamaBlock(cfg) for _ in range(cfg.n_layers)])
+        self.blocks = nn.ModuleList(
+            [self.block_cls(cfg) for _ in range(cfg.n_layers)]
+        )
         self.norm = nn.RMSNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
         self.lm_head = nn.Linear(
             cfg.dim, cfg.vocab_size, bias=False, dtype=cfg.dtype,
