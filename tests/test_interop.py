@@ -161,3 +161,72 @@ def test_round_trip_export_import():
     from_torch_state_dict(dst, exported, kmap)
     for (k, a), (_, b) in zip(src.named_parameters(), dst.named_parameters()):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=k)
+
+
+def test_mixtral_matches_hf_forward():
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=32,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    from torchdistx_tpu.interop.torch_interop import mixtral_key_map
+    from torchdistx_tpu.models import Mixtral
+    from torchdistx_tpu.models.mixtral import MixtralConfig
+
+    ours = Mixtral(
+        MixtralConfig(
+            vocab_size=128,
+            dim=32,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            ffn_dim=64,
+            n_experts=4,
+            top_k=2,
+            max_seq_len=32,
+            dtype=jnp.float32,
+            norm_eps=1e-5,  # HF MixtralConfig rms_norm_eps default
+        )
+    )
+    from_torch_state_dict(ours, hf.state_dict(), mixtral_key_map(2, 4))
+
+    tokens = np.random.RandomState(2).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens)).logits.numpy()
+    our_logits = np.asarray(ours(jnp.asarray(tokens)))
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=1e-3, atol=1e-3)
+
+
+def test_mixtral_round_trip_export_import():
+    from torchdistx_tpu.interop.torch_interop import (
+        mixtral_key_map,
+        to_torch_state_dict,
+    )
+    from torchdistx_tpu.models import Mixtral
+
+    tdx.manual_seed(3)
+    src = Mixtral.from_name("tiny")
+    kmap = mixtral_key_map(src.cfg.n_layers, src.cfg.n_experts)
+    exported = to_torch_state_dict(src, kmap)
+    # stacked (E, D, F) exports as per-expert HF (F, D) Linears
+    w = dict(src.named_parameters())["blocks.0.mlp.w_gate"]
+    assert (
+        exported["model.layers.0.block_sparse_moe.experts.0.w1.weight"].shape
+        == w.shape[1:][::-1]
+    )
+
+    tdx.manual_seed(77)
+    dst = Mixtral.from_name("tiny")
+    from_torch_state_dict(dst, exported, kmap)
+    for (k, a), (_, b) in zip(src.named_parameters(), dst.named_parameters()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=k)

@@ -2,6 +2,7 @@ from .torch_interop import (
     from_torch_state_dict,
     gpt2_key_map,
     llama_key_map,
+    mixtral_key_map,
     t5_key_map,
     to_torch_state_dict,
 )
@@ -11,5 +12,6 @@ __all__ = [
     "to_torch_state_dict",
     "gpt2_key_map",
     "llama_key_map",
+    "mixtral_key_map",
     "t5_key_map",
 ]

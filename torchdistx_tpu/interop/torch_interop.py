@@ -8,13 +8,17 @@ tensors one at a time — convert to numpy, optionally transpose, then
 host RAM stays at one tensor's footprint, mirroring the memory discipline
 of sharded materialization.
 
-Key maps are provided for the three HF transformer families this framework
-ships (GPT-2, Llama, T5).  Each map is ``ours -> (theirs, transform)``.
+Key maps are provided for the four HF transformer families this framework
+ships (GPT-2, Llama, Mixtral, T5).  Each map entry is
+``ours -> (theirs, transform)``, or ``ours -> [(theirs, transform), ...]``
+when one of our tensors stacks several torch tensors along a new leading
+axis (Mixtral's per-expert ``experts.{e}.w1/w2/w3`` become our stacked
+``(E, ...)`` MoE weights).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Union
 
 import jax
 import numpy as np
@@ -24,11 +28,13 @@ __all__ = [
     "to_torch_state_dict",
     "gpt2_key_map",
     "llama_key_map",
+    "mixtral_key_map",
     "t5_key_map",
 ]
 
 Transform = Optional[Callable[[np.ndarray], np.ndarray]]
-KeyMap = dict[str, tuple[str, Transform]]
+KeyEntry = Union[tuple[str, Transform], list[tuple[str, Transform]]]
+KeyMap = dict[str, KeyEntry]
 
 _T = lambda a: a.T  # noqa: E731  (HF Conv1D stores (in, out))
 
@@ -55,7 +61,12 @@ def from_torch_state_dict(
     """Load a torch state dict into ``module`` in place.
 
     Args:
-      key_map: ``{our_name: (torch_name, transform|None)}``.
+      key_map: ``{our_name: (torch_name, transform|None)}``; an entry may
+        instead be a LIST ``[(torch_name, transform), ...]`` whose arrays
+        stack along a new leading axis into one of our tensors (Mixtral's
+        per-expert weights -> stacked ``(E, ...)`` einsum operands),
+        filled slice-by-slice so host RAM holds the stacked target plus
+        one source slice.
       sharding_rule: per-entry target sharding (same rule shape as
         ``materialize_module``); tensors are placed as they stream.
       dtype: optional cast applied to every tensor (e.g. ``jnp.bfloat16``).
@@ -65,18 +76,39 @@ def from_torch_state_dict(
     missing = [k for k in key_map if k not in own]
     if missing:
         raise KeyError(f"key_map targets not in module: {missing[:5]}")
-    for ours, (theirs, transform) in key_map.items():
-        if theirs not in state_dict:
+    for ours, entry in key_map.items():
+        sources = entry if isinstance(entry, list) else [entry]
+        absent = [t for t, _ in sources if t not in state_dict]
+        if absent:
             if strict:
-                raise KeyError(f"torch state dict is missing {theirs!r}")
+                raise KeyError(f"torch state dict is missing {absent[0]!r}")
             continue
-        arr = _to_numpy(state_dict[theirs])
-        if transform is not None:
-            arr = transform(arr)
+        if not isinstance(entry, list):
+            theirs, transform = entry
+            arr = _to_numpy(state_dict[theirs])
+            if transform is not None:
+                arr = transform(arr)
+        else:
+            # list entries stack along a new leading axis (the expert
+            # dim), filled slice-by-slice to keep the one-tensor host-RAM
+            # discipline: peak = stacked target + one source slice
+            first = _to_numpy(state_dict[sources[0][0]])
+            if sources[0][1] is not None:
+                first = sources[0][1](first)
+            arr = np.empty((len(sources),) + first.shape, first.dtype)
+            arr[0] = first
+            del first
+            for j, (theirs, transform) in enumerate(sources[1:], start=1):
+                a = _to_numpy(state_dict[theirs])
+                arr[j] = transform(a) if transform is not None else a
+                del a
         expected = own[ours]
+        src_desc = sources[0][0] if len(sources) == 1 else (
+            f"{len(sources)} stacked keys [{sources[0][0]}, ...]"
+        )
         if tuple(arr.shape) != tuple(expected.shape):
             raise ValueError(
-                f"{ours}: shape {arr.shape} from {theirs!r} does not match "
+                f"{ours}: shape {arr.shape} from {src_desc} does not match "
                 f"module shape {tuple(expected.shape)}"
             )
         if dtype is not None:
@@ -110,11 +142,8 @@ def to_torch_state_dict(
     if missing:
         raise KeyError(f"key_map sources not in module: {missing[:5]}")
     out: dict[str, Any] = {}
-    for ours, (theirs, transform) in key_map.items():
-        arr = np.asarray(own[ours])
-        if transform is not None:
-            # identity or transpose — self-inverse either way
-            arr = transform(arr)
+
+    def emit(theirs, arr):
         if as_torch:
             import torch
 
@@ -123,6 +152,27 @@ def to_torch_state_dict(
             out[theirs] = torch.from_numpy(np.array(arr, copy=True))
         else:
             out[theirs] = arr
+
+    for ours, entry in key_map.items():
+        arr = np.asarray(own[ours])
+        if isinstance(entry, list):
+            # stacked entry: unstack the leading (expert) axis back out
+            if arr.shape[0] != len(entry):
+                raise ValueError(
+                    f"{ours}: leading dim {arr.shape[0]} != "
+                    f"{len(entry)} mapped keys"
+                )
+            for slice_, (theirs, transform) in zip(arr, entry):
+                emit(
+                    theirs,
+                    transform(slice_) if transform is not None else slice_,
+                )
+            continue
+        theirs, transform = entry
+        if transform is not None:
+            # identity or transpose — self-inverse either way
+            arr = transform(arr)
+        emit(theirs, arr)
     return out
 
 
@@ -186,6 +236,53 @@ def llama_key_map(n_layers: int) -> KeyMap:
                 f"{b}.mlp.w_gate.weight": (f"{h}.mlp.gate_proj.weight", None),
                 f"{b}.mlp.w_up.weight": (f"{h}.mlp.up_proj.weight", None),
                 f"{b}.mlp.w_down.weight": (f"{h}.mlp.down_proj.weight", None),
+            }
+        )
+    return m
+
+
+def mixtral_key_map(n_layers: int, n_experts: int) -> KeyMap:
+    """HF ``MixtralForCausalLM`` (``model.*``) -> our :class:`Mixtral`.
+
+    Attention/norm naming follows Llama.  HF stores each expert's SwiGLU
+    as separate ``experts.{e}.w1/w3/w2`` Linears with (out, in) weights;
+    ours stack them as (E, D, F) / (E, F, D) einsum operands — each
+    expert transposes and the loader stacks along the new leading axis.
+    Routing math matches: HF's softmax-over-top-k logits equals our
+    renormalized top-k of the full softmax.
+    """
+    m: KeyMap = {
+        "tok_emb.weight": ("model.embed_tokens.weight", None),
+        "norm.weight": ("model.norm.weight", None),
+        "lm_head.weight": ("lm_head.weight", None),
+    }
+    for i in range(n_layers):
+        h, b = f"model.layers.{i}", f"blocks.{i}"
+        moe = f"{h}.block_sparse_moe"
+        m.update(
+            {
+                f"{b}.attn_norm.weight": (f"{h}.input_layernorm.weight", None),
+                f"{b}.attn.wq.weight": (f"{h}.self_attn.q_proj.weight", None),
+                f"{b}.attn.wk.weight": (f"{h}.self_attn.k_proj.weight", None),
+                f"{b}.attn.wv.weight": (f"{h}.self_attn.v_proj.weight", None),
+                f"{b}.attn.wo.weight": (f"{h}.self_attn.o_proj.weight", None),
+                f"{b}.mlp_norm.weight": (
+                    f"{h}.post_attention_layernorm.weight",
+                    None,
+                ),
+                f"{b}.mlp.router.weight": (f"{moe}.gate.weight", None),
+                f"{b}.mlp.w_gate": [
+                    (f"{moe}.experts.{e}.w1.weight", _T)
+                    for e in range(n_experts)
+                ],
+                f"{b}.mlp.w_up": [
+                    (f"{moe}.experts.{e}.w3.weight", _T)
+                    for e in range(n_experts)
+                ],
+                f"{b}.mlp.w_down": [
+                    (f"{moe}.experts.{e}.w2.weight", _T)
+                    for e in range(n_experts)
+                ],
             }
         )
     return m
