@@ -10,7 +10,9 @@ A gradient-poisoning fault is injected mid-run to show the recovery.
 
 Run on a TPU host:          python examples/elastic_training.py
 Run on CPU (8 virtual):     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-                            JAX_PLATFORMS=cpu python examples/elastic_training.py
+                            TDX_PLATFORM=cpu python examples/elastic_training.py
+(TDX_PLATFORM uses jax.config, which wins even where a sitecustomize
+pins JAX_PLATFORMS — same hook as bench.py.)
 """
 
 import os
@@ -18,6 +20,11 @@ import sys
 import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+if os.environ.get("TDX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["TDX_PLATFORM"])
 
 import jax
 import jax.numpy as jnp

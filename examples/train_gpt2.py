@@ -3,13 +3,20 @@ devices, and train on a synthetic token stream with AnyPrecisionAdamW.
 
 Run on a TPU host:          python examples/train_gpt2.py
 Run on CPU (8 virtual):     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-                            JAX_PLATFORMS=cpu python examples/train_gpt2.py
+                            TDX_PLATFORM=cpu python examples/train_gpt2.py
+(TDX_PLATFORM uses jax.config, which wins even where a sitecustomize
+pins JAX_PLATFORMS — same hook as bench.py.)
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+if os.environ.get("TDX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["TDX_PLATFORM"])
 
 import numpy as np
 

@@ -230,3 +230,21 @@ def test_mixtral_round_trip_export_import():
     from_torch_state_dict(dst, exported, kmap)
     for (k, a), (_, b) in zip(src.named_parameters(), dst.named_parameters()):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=k)
+
+
+def test_partial_stacked_group_raises_even_nonstrict():
+    from torchdistx_tpu.interop.torch_interop import (
+        mixtral_key_map,
+        to_torch_state_dict,
+    )
+    from torchdistx_tpu.models import Mixtral
+
+    tdx.manual_seed(5)
+    m = Mixtral.from_name("tiny")
+    kmap = mixtral_key_map(m.cfg.n_layers, m.cfg.n_experts)
+    sd = to_torch_state_dict(m, kmap)
+    # drop ONE expert of one stacked group: a broken checkpoint, not an
+    # intentional omission -> must raise even with strict=False
+    del sd["model.layers.0.block_sparse_moe.experts.1.w1.weight"]
+    with pytest.raises(KeyError, match="partial group"):
+        from_torch_state_dict(m, sd, kmap, strict=False)

@@ -82,6 +82,15 @@ def from_torch_state_dict(
         if absent:
             if strict:
                 raise KeyError(f"torch state dict is missing {absent[0]!r}")
+            if len(absent) < len(sources):
+                # a PARTIALLY-present stacked group is a broken checkpoint,
+                # not an intentionally omitted tensor — skipping it would
+                # silently leave every expert at random init
+                raise KeyError(
+                    f"{ours}: stacked group has {len(absent)} of "
+                    f"{len(sources)} source keys missing (e.g. "
+                    f"{absent[0]!r}) — refusing to skip a partial group"
+                )
             continue
         if not isinstance(entry, list):
             theirs, transform = entry
