@@ -262,3 +262,88 @@ class TestSamplingFilters:
             m, p, 2, temperature=1.0, top_k=10**6, key=jax.random.PRNGKey(0)
         )
         assert out.shape == (1, 5)
+
+
+class TestFlashPrefill:
+    """The from-empty prefill routes through the flash kernel when
+    use_flash resolves on; parity vs the jnp cache path (interpret mode
+    on CPU — exact)."""
+
+    def test_cached_attention_flash_prefill_parity(self):
+        from torchdistx_tpu.ops.attention import cached_attention
+
+        rs = np.random.RandomState(6)
+        b, s, hq, hkv, d, max_seq = 2, 16, 4, 2, 8, 32
+        q = jnp.asarray(rs.randn(b, s, hq, d), jnp.float32)
+        k = jnp.asarray(rs.randn(b, s, hkv, d), jnp.float32)
+        v = jnp.asarray(rs.randn(b, s, hkv, d), jnp.float32)
+        cache = (
+            jnp.zeros((b, max_seq, hkv, d)),
+            jnp.zeros((b, max_seq, hkv, d)),
+        )
+        out_jnp, (ck1, cv1) = cached_attention(
+            q, k, v, cache, 0, use_flash=False
+        )
+        out_flash, (ck2, cv2) = cached_attention(
+            q, k, v, cache, 0, use_flash=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_flash), np.asarray(out_jnp), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_array_equal(np.asarray(ck1), np.asarray(ck2))
+        np.testing.assert_array_equal(np.asarray(cv1), np.asarray(cv2))
+
+    def test_traced_cache_pos_stays_on_jnp_path(self):
+        # a TRACED cache_pos (mid-cache chunked prefill) must not take the
+        # flash branch: its causal mask is end-aligned, not pos-aligned,
+        # so at pos > 0 the two paths DIVERGE — chunk 2 must still see
+        # chunk 1's cached keys
+        from torchdistx_tpu.ops.attention import (
+            cached_attention,
+            multihead_attention,
+        )
+
+        rs = np.random.RandomState(7)
+        b, s, hkv, d, max_seq = 1, 8, 2, 8, 32
+        q = jnp.asarray(rs.randn(b, s, hkv, d), jnp.float32)
+        k = jnp.asarray(rs.randn(b, s, hkv, d), jnp.float32)
+        v = jnp.asarray(rs.randn(b, s, hkv, d), jnp.float32)
+        cache = (
+            jnp.zeros((b, max_seq, hkv, d)),
+            jnp.zeros((b, max_seq, hkv, d)),
+        )
+
+        @jax.jit
+        def two_chunks(pos):
+            # chunk 1 at static 0, chunk 2 at TRACED pos — the traced call
+            # must route to the jnp path even with use_flash=True
+            _, c = cached_attention(
+                q[:, :4], k[:, :4], v[:, :4], cache, 0, use_flash=True
+            )
+            out2, _ = cached_attention(
+                q[:, 4:], k[:, 4:], v[:, 4:], c, pos, use_flash=True
+            )
+            return out2
+
+        whole = multihead_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(two_chunks(jnp.int32(4))),
+            np.asarray(whole[:, 4:]),
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
+    def test_generate_with_flash_prefill_matches_full_recompute(self):
+        tdx.manual_seed(8)
+        m = Llama.from_name(
+            "tiny", n_kv_heads=2, max_seq_len=64, use_flash=True
+        )
+        prompt = jnp.asarray(
+            np.random.RandomState(9).randint(0, 256, (1, 10)), jnp.int32
+        )
+        out = generate(m, prompt, max_new_tokens=4)
+        cur = prompt
+        for _ in range(4):
+            nxt = jnp.argmax(m(cur)[:, -1], axis=-1)[:, None]
+            cur = jnp.concatenate([cur, nxt.astype(cur.dtype)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))

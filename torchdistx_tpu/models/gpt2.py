@@ -95,7 +95,9 @@ class GPT2Block(nn.Module):
         h = self.ln1(x)
         qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        a, cache = cached_attention(q, k, v, cache, cache_pos)
+        a, cache = cached_attention(
+            q, k, v, cache, cache_pos, use_flash=self.use_flash
+        )
         x = x + self.attn_out(a.reshape(b, s, d))
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache

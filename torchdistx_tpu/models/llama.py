@@ -185,7 +185,9 @@ class LlamaAttention(nn.Module):
         v = self.wv(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, rope, cache_pos)
         k = apply_rope(k, rope, cache_pos)
-        out, cache = cached_attention(q, k, v, cache, cache_pos)
+        out, cache = cached_attention(
+            q, k, v, cache, cache_pos, use_flash=cfg.use_flash
+        )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 
 

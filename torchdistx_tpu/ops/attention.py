@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = [
@@ -46,6 +47,7 @@ def cached_attention(
     *,
     scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,
+    use_flash: Optional[bool] = None,
 ):
     """Incremental attention against a static-shape KV cache — the shared
     decode primitive behind every model's ``forward_cached``.
@@ -58,6 +60,16 @@ def cached_attention(
     defaults to 1/sqrt(D) (pass 1.0 for T5's unscaled dot products);
     ``bias`` is an optional (H, S, max_seq) additive logit bias (T5's
     relative-position bias).  f32 softmax.  Returns (out, (ck, cv)).
+
+    **Flash prefill**: the from-empty prefill (``cache_pos == 0`` as a
+    STATIC int, S > 1, no bias) is mathematically ordinary causal
+    attention over the new keys alone — no written-before-this-call cache
+    slot is visible — so it routes through the pallas flash kernel when
+    ``use_flash`` resolves on (``resolve_use_flash``: auto = TPU).  That
+    is the path ``generate()`` takes for every prompt, so long-context
+    prefill stops materializing the (S, max_seq) logits matrix that OOMs
+    at 8k+.  Mid-cache chunked prefill (``cache_pos`` traced or > 0)
+    stays on the jnp path.
     """
     b, s, hq, d = q.shape
     ck, cv = cache
@@ -67,6 +79,32 @@ def cached_attention(
     cv = lax.dynamic_update_slice(
         cv, v_new.astype(cv.dtype), (0, cache_pos, 0, 0)
     )
+    from .flash_attention import flash_attention, resolve_use_flash
+
+    if (
+        bias is None
+        and s > 1
+        and isinstance(cache_pos, (int, np.integer))
+        and int(cache_pos) == 0
+        and resolve_use_flash(use_flash)
+    ):
+        # pad the sequence to a lane multiple so arbitrary (odd/prime)
+        # prompt lengths keep MXU-shaped blocks instead of shrinking the
+        # kernel's block size toward 1.  Equal q/k padding preserves the
+        # end-aligned causal mask for every real query (row i still sees
+        # exactly keys 0..i); padded rows are sliced off.
+        pad = (-s) % 128
+        if pad:
+            widen = lambda a: jnp.pad(  # noqa: E731
+                a, ((0, 0), (0, pad), (0, 0), (0, 0))
+            )
+            out = flash_attention(
+                widen(q), widen(k_new), widen(v_new),
+                causal=True, scale=scale,
+            )[:, :s]
+        else:
+            out = flash_attention(q, k_new, v_new, causal=True, scale=scale)
+        return out, (ck, cv)
     max_seq, hkv = ck.shape[1], ck.shape[2]
     kk = _repeat_kv(ck, hq // hkv)
     vv = _repeat_kv(cv, hq // hkv)
