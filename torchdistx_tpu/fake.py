@@ -291,6 +291,33 @@ class FakeArray:
 
     # -- ops (recorded / shape-propagated) --------------------------------
 
+    # numpy interop: without these, ``np_scalar * fake`` runs numpy's own
+    # op, which coerces via ``np.asarray(fake)`` — force-materializing a
+    # deferred fake (or raising for a plain one) where propagation is
+    # wanted (jax.nn bodies mix numpy scalars in: ``sqrt_2_over_pi * x``).
+    # The priority makes numpy scalars defer to the reflected dunder; the
+    # ufunc hook routes numpy ufuncs through the matching jnp op so even
+    # ``np.multiply(ndarray, fake)`` propagates.
+    __array_priority__ = 100
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        fn = getattr(jnp, ufunc.__name__, None) if method == "__call__" else None
+        if fn is None or kwargs:
+            # numpy-only surface (out=/where=/dtype=/casting=, .reduce/
+            # .accumulate/...): jnp has no matching signature, and an
+            # override returning NotImplemented would make numpy RAISE, not
+            # coerce — so restore the pre-override path explicitly: coerce
+            # fakes via __array__ (deferred fakes force-materialize; plain
+            # fakes raise the framework storage error) and run numpy.
+            import numpy as np
+
+            coerced = [
+                np.asarray(x) if isinstance(x, FakeArray) else x
+                for x in inputs
+            ]
+            return getattr(ufunc, method)(*coerced, **kwargs)
+        return self._op(fn, *inputs)
+
     def _op(self, fn, *args, **kwargs):
         from .ops import apply_op
 

@@ -298,6 +298,96 @@ class _Patcher:
                 wrapper = _wrap_callable(f"random_{name}", orig, True)
                 self._saved.append((jax.random, name, orig))
                 setattr(jax.random, name, wrapper)
+            # jax.nn activations (relu/gelu/softmax/...): two-level coverage.
+            # Level 1 — the public namespace, so attribute-style calls
+            # (``jax.nn.gelu(fake)``) fake-propagate instead of leaking a
+            # raw JAX type error.  None are creation ops: they all take an
+            # array argument, so the fake-arg scan is the trigger.
+            import jax.nn as _jax_nn
+
+            for name in dir(_jax_nn):
+                if name.startswith("_"):
+                    continue
+                orig = getattr(_jax_nn, name, None)
+                if orig is None or not _wrappable(orig):
+                    continue
+                wrapper = _wrap_callable(f"nn.{name}", orig, False)
+                self._saved.append((_jax_nn, name, orig))
+                setattr(_jax_nn, name, wrapper)
+            # Level 2 — the internal functions module's call-time globals
+            # (``jnp``/``lax``), so references captured BEFORE the patch
+            # (``from jax.nn import relu`` at user-module import, which
+            # typically precedes the first fake/deferred entry) are still
+            # covered: the captured function body resolves ``jnp.maximum``
+            # etc. from these module globals on every call — the same
+            # trick as the initializers coverage below.
+            try:
+                from jax._src.nn import functions as _nn_internal
+            except ImportError:  # jax layout changed: public patch only
+                _nn_internal = None
+            if _nn_internal is not None:
+                # numpy_util is proxied too: bodies validate/promote via
+                # numpy_util.promote_args_inexact(name, x) BEFORE any jnp
+                # op, and that helper type-rejects a FakeArray.  Through
+                # the proxy it routes apply_op (string arg rides the
+                # static template), so promotion shape-propagates.
+                for attr, creation in (("jnp", _JNP_CREATION),
+                                       ("lax", set()),
+                                       ("numpy_util", set())):
+                    target = getattr(_nn_internal, attr, None)
+                    if not isinstance(target, types.ModuleType):
+                        continue
+                    self._saved.append((_nn_internal, attr, target))
+                    setattr(
+                        _nn_internal,
+                        attr,
+                        _ModuleProxy(target, creation, f"nn.{attr}"),
+                    )
+            # Level 3 — custom_jvp/custom_vjp __call__ (class-level): relu
+            # and friends are custom-derivative OBJECTS whose __call__
+            # type-rejects a FakeArray before the body (and its patched
+            # globals) ever run.  Hooking the class catches every
+            # custom-derivative callable — including third-party ones —
+            # which is the closest JAX analog of the reference's
+            # dispatcher catch-all.  eval_shape traces the object fine,
+            # so apply_op needs no special casing.
+            try:
+                from jax._src import custom_derivatives as _cd
+            except ImportError:
+                _cd = None
+            if _cd is not None:
+                for cls_name in ("custom_jvp", "custom_vjp"):
+                    cls = getattr(_cd, cls_name, None)
+                    if cls is None:
+                        continue
+                    orig_call = cls.__call__
+                    if hasattr(orig_call, "__wrapped_original__"):
+                        continue
+
+                    def _make_call(orig_call):
+                        @functools.wraps(orig_call)
+                        def call(self, *args, **kwargs):
+                            if _has_fake(args) or _has_fake(kwargs.values()):
+                                from . import apply_op
+
+                                name = getattr(
+                                    getattr(self, "fun", None),
+                                    "__name__",
+                                    "custom_derivative_call",
+                                )
+                                return apply_op(
+                                    functools.partial(orig_call, self),
+                                    *args,
+                                    op_name=name,
+                                    **kwargs,
+                                )
+                            return orig_call(self, *args, **kwargs)
+
+                        call.__wrapped_original__ = orig_call
+                        return call
+
+                    self._saved.append((cls, "__call__", orig_call))
+                    setattr(cls, "__call__", _make_call(orig_call))
             # jax.nn.initializers: interpose the internal module's call-time
             # globals so every initializer closure is covered regardless of
             # when it was created (see module docstring).  Samplers are

@@ -111,7 +111,11 @@ class GossipGraDState(DefaultState):
     DISSEMINATION (gossip_grad.py: ``topology or Topology.DISSEMINATION``),
     and a ``num_modules`` correction for trainers that invoke the hook once
     per wrapped submodule (gossip_grad.py:319-331,373-379; ours calls it
-    once per step, so the default is 1).
+    once per step, so the default is 1).  One documented deviation: the
+    pre-generated topology set is capped at ``max_branches //
+    gossip_period`` shuffles (first effective at n=17 with the default
+    64-branch budget) to bound jit compile cost — see the inline
+    compile-cost note in ``__init__``.
 
     Tests may inject a deterministic schedule by assigning
     ``state.topologies_set = [perm, ...]`` +
@@ -131,6 +135,7 @@ class GossipGraDState(DefaultState):
         seed: int = 2403,
         gossip_period: Optional[int] = None,
         num_modules: int = 1,
+        max_branches: int = 64,
     ) -> None:
         super().__init__()
         if num_nodes < 2:
@@ -159,6 +164,29 @@ class GossipGraDState(DefaultState):
         for _ in range(num_nodes):
             rng.shuffle(nodes)
             topologies.append(tuple(nodes))
+        # Compile-cost bound.  Every unique (send, recv) peer table becomes
+        # one CollectivePermute branch of the jitted step's ``lax.switch``;
+        # un-capped that is worst-case ``num_nodes * gossip_period``
+        # branches (64 nodes -> up to 384), each of which XLA compiles and
+        # carries in the executable.  Compile time and code size grow
+        # ~linearly in the branch count, so the *topology set* is capped at
+        # ``max_branches // gossip_period`` permutations — the schedule
+        # cycles through fewer distinct shuffles (partner diversity per
+        # rotation window is unchanged: each window still sweeps all
+        # ``gossip_period`` strides of a fresh permutation).  At the
+        # default 64-branch budget nothing changes through 16 nodes (n=17
+        # is the first truncation: period 5, 12 of 17 kept); n=64
+        # keeps 10 of its 64 shuffles.  Raise ``max_branches`` to trade
+        # compile time for a longer topology cycle.
+        if max_branches < self.gossip_period:
+            raise ValueError(
+                f"max_branches={max_branches} cannot hold even one "
+                f"topology's {self.gossip_period} exchange powers"
+            )
+        self.max_branches = max_branches
+        keep = max(1, max_branches // self.gossip_period)
+        if len(topologies) > keep:
+            topologies = topologies[:keep]
         self.topologies_set: Sequence[Sequence[int]] = topologies
         self.topology_cycle: Iterator[int] = itertools.cycle(
             range(len(topologies))
