@@ -245,6 +245,123 @@ class TestPipelineTrainStep:
         assert losses[-1] < 0.5 * losses[0]
 
 
+class Test3DComposition:
+    """The canonical 3D parallelism: dp x tp x pp in ONE 1F1B train step.
+    Megatron column/row-split MLP stages (``param_specs`` shards the
+    weights over tp; the f/g custom-VJP collectives carry the tp
+    reductions inside ``stage_fn``), microbatch batch dim over dp, stages
+    over pp — loss and every stage's global gradient must equal
+    single-device unpipelined autodiff exactly (VERDICT r3 item 7;
+    reference motivation deferred_init.rst:26-27)."""
+
+    @staticmethod
+    def _tp_stage_fn(p, x):
+        # weights arrive tp-LOCAL: w1 (h/tp, d) column-parallel, w2
+        # (d, h/tp) row-parallel; activations tp-replicated at the edges.
+        # Megatron f/g operators (collectives.copy_psum_grad /
+        # allreduce_linear) carry the tp collectives with the correct
+        # custom VJPs — a plain psum double-counts grads under
+        # check_vma=False (see collectives.allreduce_linear docstring).
+        from torchdistx_tpu.parallel import collectives
+
+        xin = collectives.copy_psum_grad(x, "tp")
+        h = jax.nn.relu(xin @ p["w1"].T + p["b1"])
+        y = collectives.allreduce_linear(h @ p["w2"].T, "tp") + p["b2"]
+        return x + y
+
+    @staticmethod
+    def _ref_stage_fn(p, x):
+        h = jax.nn.relu(x @ p["w1"].T + p["b1"])
+        return x + h @ p["w2"].T + p["b2"]
+
+    def test_forward_pipeline_apply_with_tp_specs(self):
+        # pipeline_apply's param_specs hook: tp-sharded stage weights in
+        # the forward-only GPipe schedule must match sequential exactly
+        mesh = create_mesh({"tp": 2, "pp": 4})
+        d, h = 8, 16
+        rs = np.random.RandomState(20)
+        stages = [
+            {
+                "w1": jnp.asarray(rs.randn(h, d).astype(np.float32) * 0.1),
+                "b1": jnp.asarray(rs.randn(h).astype(np.float32) * 0.1),
+                "w2": jnp.asarray(rs.randn(d, h).astype(np.float32) * 0.1),
+                "b2": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1),
+            }
+            for _ in range(4)
+        ]
+        specs = {
+            "w1": P("pp", "tp", None),
+            "b1": P("pp", "tp"),
+            "w2": P("pp", None, "tp"),
+            "b2": P("pp", None),
+        }
+        stacked = jax.device_put(
+            stack_pipeline_stages(stages, mesh),
+            {k: NamedSharding(mesh, s) for k, s in specs.items()},
+        )
+        mb = jnp.asarray(rs.randn(3, 4, d).astype(np.float32))
+        out = pipeline_apply(
+            stacked, mb, mesh=mesh, stage_fn=self._tp_stage_fn,
+            param_specs=specs,
+        )
+        ref = mb
+        for p in stages:
+            ref = jax.vmap(lambda x, p=p: self._ref_stage_fn(p, x))(ref)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_dp_tp_pp_loss_and_grads_match_single_device(self):
+        mesh = create_mesh({"dp": 2, "tp": 2, "pp": 2})
+        d, h = 8, 16
+        rs = np.random.RandomState(21)
+        stages = [
+            {
+                "w1": jnp.asarray(rs.randn(h, d).astype(np.float32) * 0.1),
+                "b1": jnp.asarray(rs.randn(h).astype(np.float32) * 0.1),
+                "w2": jnp.asarray(rs.randn(d, h).astype(np.float32) * 0.1),
+                "b2": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1),
+            }
+            for _ in range(2)
+        ]
+        specs = {
+            "w1": P("pp", "tp", None),
+            "b1": P("pp", "tp"),
+            "w2": P("pp", None, "tp"),
+            "b2": P("pp", None),
+        }
+        stacked = stack_pipeline_stages(stages, mesh)
+        stacked = jax.device_put(
+            stacked,
+            {k: NamedSharding(mesh, s) for k, s in specs.items()},
+        )
+        mb = jnp.asarray(rs.randn(4, 4, d).astype(np.float32))
+        tgt = jnp.asarray(rs.randn(4, 4, d).astype(np.float32))
+        mb = jax.device_put(mb, NamedSharding(mesh, P(None, "dp")))
+        tgt = jax.device_put(tgt, NamedSharding(mesh, P(None, "dp")))
+
+        loss, g = pipeline_train_step(
+            stacked, mb, tgt,
+            mesh=mesh,
+            stage_fn=self._tp_stage_fn,
+            loss_fn=_mse,
+            dp_axis="dp",
+            param_specs=specs,
+        )
+        l_ref, g_ref = jax.value_and_grad(_seq_loss)(
+            stages, mb, tgt, self._ref_stage_fn
+        )
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-6)
+        for i in range(2):
+            for k in ("w1", "b1", "w2", "b2"):
+                np.testing.assert_allclose(
+                    np.asarray(g[k][i]),
+                    np.asarray(g_ref[i][k]),
+                    rtol=1e-5,
+                    atol=1e-6,
+                )
+
+
 class TestLlamaPipeline:
     """The VERDICT bar: stage params produced by deferred_init from real
     Llama blocks, stacked with stack_pipeline_stages, trained with the

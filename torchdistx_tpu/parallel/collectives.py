@@ -33,6 +33,8 @@ __all__ = [
     "shift",
     "all_gather",
     "reduce_scatter",
+    "allreduce_linear",
+    "copy_psum_grad",
     "axis_index",
     "axis_size",
 ]
@@ -132,6 +134,53 @@ def reduce_scatter(tree: Any, axis: str, scatter_axis: int = 0) -> Any:
         lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
         tree,
     )
+
+
+def allreduce_linear(tree: Any, axis: str) -> Any:
+    """All-reduce whose BACKWARD is identity — Megatron's ``g`` operator,
+    placed after a row-parallel matmul.
+
+    Needed because under ``shard_map(..., check_vma=False)`` JAX cannot
+    prove the cotangent is axis-replicated, so a plain ``lax.psum``
+    transposes to another ``psum`` and grads upstream of the reduction
+    come back multiplied by the axis size.  Mathematically the VJP of an
+    all-reduce applied to a replicated cotangent IS identity; this
+    custom_vjp states that.
+    """
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis)
+
+    def g_fwd(x):
+        return lax.psum(x, axis), None
+
+    def g_bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(g_fwd, g_bwd)
+    return jax.tree_util.tree_map(g, tree)
+
+
+def copy_psum_grad(tree: Any, axis: str) -> Any:
+    """Identity whose BACKWARD is an all-reduce — Megatron's ``f``
+    operator, placed where a replicated activation ENTERS a
+    tensor-parallel region: each rank's backward produces only its
+    shard's contribution to the input gradient, and the psum restores the
+    full (replicated) cotangent."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, None
+
+    def f_bwd(_, ct):
+        return (lax.psum(ct, axis),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return jax.tree_util.tree_map(f, tree)
 
 
 def axis_index(axis: str):

@@ -37,6 +37,18 @@ Data parallelism composes: pass ``dp_axis`` and shard the microbatch
 batch dim over it (``P(None, "dp", ...)``); per-stage parameter gradients
 are ``pmean``-reduced over ``dp`` in-pipeline, and nothing about the
 schedule changes.
+
+Tensor parallelism composes through ``param_specs``: pass per-leaf
+``PartitionSpec``s that shard stage-weight dims over a ``tp`` mesh axis
+(Megatron column/row split) and carry the tp collectives inside
+``stage_fn`` with ``collectives.copy_psum_grad`` where the replicated
+activation enters the region and ``collectives.allreduce_linear`` after
+the row-parallel matmul — NOT a plain ``lax.psum``, whose transpose
+double-counts gradients by |tp| under ``check_vma=False`` (see
+``collectives.allreduce_linear``).  The schedule is oblivious:
+activations stay tp-replicated at stage boundaries, gradients come back
+in the same tp-sharded layout as the params.  ``dryrun_multichip`` leg 7
+and ``tests/test_pp.py`` exercise the full dp x tp x pp composition.
 """
 
 from __future__ import annotations
@@ -102,6 +114,7 @@ def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     axis: str = "pp",
     dp_axis: Optional[str] = None,
+    param_specs: Optional[Any] = None,
 ) -> jax.Array:
     """Run ``microbatches`` (N_micro, *mb_shape) through the pipeline
     (forward-only GPipe schedule).
@@ -111,6 +124,10 @@ def pipeline_apply(
     stage; activations must keep the microbatch shape.  Returns the
     (N_micro, *mb_shape) outputs of the final stage.  With ``dp_axis``,
     the microbatch *batch* dim (dim 1) is sharded over that axis.
+    ``param_specs`` (a pytree of ``PartitionSpec`` matching
+    ``stage_params``, leading entry = ``axis``) overrides the default
+    pp-only sharding — the tensor-parallel composition hook (module
+    docstring).
     """
     n_stages = mesh.shape[axis]
     n_micro = microbatches.shape[0]
@@ -155,8 +172,10 @@ def pipeline_apply(
         )
         return outputs
 
-    spec_params = jax.tree_util.tree_map(
-        lambda l: P(axis, *([None] * (l.ndim - 1))), stage_params
+    spec_params = param_specs if param_specs is not None else (
+        jax.tree_util.tree_map(
+            lambda l: P(axis, *([None] * (l.ndim - 1))), stage_params
+        )
     )
     mb_spec = P(None, dp_axis) if dp_axis else P()
     return shard_map(
@@ -178,6 +197,7 @@ def pipeline_train_step(
     loss_fn: Callable[[jax.Array, Any], jax.Array],
     axis: str = "pp",
     dp_axis: Optional[str] = None,
+    param_specs: Optional[Any] = None,
 ) -> tuple[jax.Array, Any]:
     """One pipelined forward+backward: returns ``(loss, grads)``.
 
@@ -293,8 +313,10 @@ def pipeline_train_step(
         gacc = jax.tree_util.tree_map(lambda g: g[None], gacc)
         return loss, gacc
 
-    spec_params = jax.tree_util.tree_map(
-        lambda l: P(axis, *([None] * (l.ndim - 1))), stage_params
+    spec_params = param_specs if param_specs is not None else (
+        jax.tree_util.tree_map(
+            lambda l: P(axis, *([None] * (l.ndim - 1))), stage_params
+        )
     )
     mb_spec = P(None, dp_axis) if dp_axis else P()
     return shard_map(
