@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Docs consistency gate (stdlib-only, runs where mkdocs cannot).
+
+Checks, over ``docs/*.md`` and ``mkdocs.yml``:
+
+- every relative markdown link/image target exists;
+- every ``docs/*.md`` page is reachable from the mkdocs nav;
+- every nav entry points at an existing page;
+- in-page anchors referenced as ``page.md#anchor`` exist as headings.
+
+CI runs this before ``mkdocs build --strict`` so a broken cross-reference
+fails fast with a precise message; locally it is the whole docs gate
+(mkdocs is not installed in the locked image).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+DOCS = ROOT / "docs"
+
+LINK_RE = re.compile(r"!?\[[^\]]*\]\(([^)\s]+)\)")
+HEADING_RE = re.compile(r"^#+\s+(.*)$", re.MULTILINE)
+
+
+def slugify(heading: str) -> str:
+    s = re.sub(r"[`*_]", "", heading.strip().lower())
+    s = re.sub(r"[^\w\s-]", "", s)
+    return re.sub(r"[\s]+", "-", s)
+
+
+def main() -> int:
+    errors: list[str] = []
+    pages = sorted(DOCS.glob("*.md"))
+    anchors = {
+        p.name: {slugify(h) for h in HEADING_RE.findall(p.read_text())}
+        for p in pages
+    }
+
+    for page in pages:
+        for target in LINK_RE.findall(page.read_text()):
+            if target.startswith(("http://", "https://", "mailto:")):
+                continue
+            path, _, frag = target.partition("#")
+            if path:
+                resolved = (page.parent / path).resolve()
+                if not resolved.exists():
+                    errors.append(f"{page.name}: broken link -> {target}")
+                    continue
+            name = path or page.name
+            if frag and name in anchors and frag not in anchors[name]:
+                errors.append(f"{page.name}: missing anchor -> {target}")
+
+    nav_entries = set()
+    mkdocs = ROOT / "mkdocs.yml"
+    if mkdocs.exists():
+        for m in re.finditer(r":\s*([\w./-]+\.md)\s*$",
+                             mkdocs.read_text(), re.MULTILINE):
+            nav_entries.add(m.group(1))
+        for entry in sorted(nav_entries):
+            if not (DOCS / entry).exists():
+                errors.append(f"mkdocs.yml: nav entry missing -> {entry}")
+        for page in pages:
+            if page.name not in nav_entries:
+                errors.append(f"mkdocs.yml: page not in nav -> {page.name}")
+    else:
+        errors.append("mkdocs.yml not found")
+
+    for e in errors:
+        print(f"ERROR: {e}")
+    print(f"checked {len(pages)} pages, {len(nav_entries)} nav entries: "
+          f"{'FAIL' if errors else 'OK'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,7 +194,7 @@ void test_null_handle_tolerance() {
   // every entry point must no-op (not crash) on NULL — Python GC can
   // call through finalizers after the owner freed the handle
   int64_t buf[2];
-  int32_t dtype;
+  int32_t dtype = 0;
   char name[4];
   CHECK(tdx_record_op(nullptr, "x", nullptr, 0, 1) == -1);
   tdx_set_output_meta(nullptr, 0, 0, buf, 1, 0);
@@ -245,7 +245,7 @@ void test_threaded_record_pin_race() {
   CHECK(tdx_num_nodes(g) == 1 + kThreads * kOpsPerThread);
   // graph is intact: every node's deps resolve and are chronological
   for (int64_t id = 1; id < tdx_num_nodes(g); ++id) {
-    int64_t dep;
+    int64_t dep = 0;
     CHECK(tdx_get_deps(g, id, &dep, 1) == 1);
     CHECK(dep >= 0 && dep < id);
   }
