@@ -335,6 +335,38 @@ class TestChunkedReplay:
         # executors are dropped once the graph is fully materialized
         assert session._chunk_cache == {}
 
+    def test_auto_mode_decisions(self):
+        # auto compares estimated compile counts (distinct closure sigs vs
+        # weighted distinct chunk sigs): conv graphs chunk, transformer
+        # graphs stay eager, and off-accelerator everything stays eager
+        from torchdistx_tpu.models import Llama
+        from torchdistx_tpu.models.resnet import resnet50
+
+        tdx.manual_seed(0)
+        rn = tdx.deferred_init(resnet50)
+        s_rn = next(p for _, p in rn.named_parameters())._session
+        nids = sorted(s_rn.closures.keys())
+        assert s_rn._choose_replay_mode(nids, platform="tpu") == "chunked"
+        assert s_rn._choose_replay_mode(nids, platform="cpu") == "eager"
+
+        tdx.manual_seed(0)
+        ll = tdx.deferred_init(Llama.from_name, "tiny")
+        s_ll = next(p for _, p in ll.named_parameters())._session
+        nids = sorted(s_ll.closures.keys())
+        assert s_ll._choose_replay_mode(nids, platform="tpu") == "eager"
+        assert s_ll._choose_replay_mode(nids, platform="cpu") == "eager"
+
+    def test_auto_mode_materializes_bit_identical_on_cpu(self):
+        # auto resolves to eager on CPU: bit-identity must hold end-to-end
+        eager, _ = self._materialize("eager")
+        auto, _ = self._materialize("auto")
+        for k in eager:
+            np.testing.assert_array_equal(eager[k], auto[k], err_msg=k)
+
+    def test_unknown_replay_mode_raises(self):
+        with pytest.raises(ValueError, match="replay_mode"):
+            self._materialize("bogus")
+
     def test_chunk_bounds_cover_everything(self):
         from torchdistx_tpu._graph import _chunk_bounds
 
