@@ -69,11 +69,77 @@ def attention_flops(seq, fwd_only):
     return f if fwd_only else 3 * f
 
 
+def bias_rows(seqs):
+    """Biased (T5 relative-position) fwd+bwd: pallas kernel backward vs
+    the round-3 chunked-recompute backward.  Bias is O(H*S^2) memory, so
+    realistic seqs stop well short of the bias-free 64k rows."""
+    from torchdistx_tpu.ops import flash_attention as fa
+
+    results = []
+    for seq in seqs:
+        q, k, v = _inputs(seq)
+        bias = (
+            jax.random.normal(jax.random.PRNGKey(7), (H, seq, seq), jnp.bfloat16)
+            * 0.02
+        )
+        per_iter = attention_flops(seq, False)
+        iters = int(os.environ.get(
+            "TDX_BENCH_ITERS",
+            max(4, min(1024, int(3.0 * 100e12 / per_iter))),
+        ))
+
+        def biased_loss(q, k, v, b):
+            return (
+                fa.flash_attention(q, k, v, bias=b, causal=True)
+                .mean()
+                .astype(jnp.float32)
+            )
+
+        def step(q, k, v):
+            # consume EVERY gradient: an unused dk/dv/dbias is dead code
+            # XLA eliminates, and the leg would time only the dq kernel
+            grads = jax.grad(biased_loss, (0, 1, 2, 3))(q, k, v, bias)
+            return sum(g.mean().astype(jnp.float32) for g in grads)
+
+        row = {"seq": seq, "bias": True}
+        for name, forced in (("kernel_bwd", False), ("chunked_bwd", True)):
+            fa._FORCE_CHUNKED_BWD = forced
+            try:
+                dt = _time(step, q, k, v, iters=iters)
+                row[name] = dt
+                row[name + "_tflops"] = (
+                    attention_flops(seq, False) / dt / 1e12
+                )
+            except Exception as e:  # noqa: BLE001 — OOM at long seq is data
+                row[name] = None
+                row[name + "_err"] = f"{type(e).__name__}"
+            finally:
+                fa._FORCE_CHUNKED_BWD = False
+        if row.get("kernel_bwd") and row.get("chunked_bwd"):
+            row["kernel_speedup"] = row["chunked_bwd"] / row["kernel_bwd"]
+        results.append(row)
+        print(json.dumps(row))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096,8192,16384")
+    ap.add_argument(
+        "--bias", action="store_true",
+        help="measure the biased (T5) fwd+bwd kernel-vs-chunked A/B instead",
+    )
     args = ap.parse_args()
+    # smoke-testing hook (same as bench.py): sitecustomize pins the axon
+    # platform; only a pre-device jax.config update overrides it
+    plat = os.environ.get("TDX_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     seqs = [int(s) for s in args.seqs.split(",")]
+    if args.bias:
+        print(f"platform={jax.devices()[0].platform} B={B} H={H} D={D} "
+              f"bf16 biased")
+        return bias_rows(seqs)
     print(f"platform={jax.devices()[0].platform} B={B} H={H} D={D} bf16")
     results = []
     for seq in seqs:
@@ -81,7 +147,10 @@ def main():
         # size the scan so the timed region is multi-second at ~100 TFLOP/s
         # effective (relay-proof timing, CLAUDE.md)
         per_iter = attention_flops(seq, True)
-        iters = max(8, min(4096, int(4.0 * 100e12 / per_iter)))
+        iters = int(os.environ.get(
+            "TDX_BENCH_ITERS",
+            max(8, min(4096, int(4.0 * 100e12 / per_iter))),
+        ))
 
         def ref_fwd(q, k, v):
             return multihead_attention(q, k, v, causal=True).mean().astype(
@@ -94,14 +163,19 @@ def main():
             )
 
         def ref_step(q, k, v):
-            return jax.grad(lambda a, b, c: ref_fwd(a, b, c).sum(), (0, 1, 2))(
-                q, k, v
-            )[0].mean().astype(jnp.float32)
+            # sum over ALL grads — keeping only dq lets XLA dead-code the
+            # dK/dV work out of the timed region (round-3 rows used [0];
+            # re-measured rows supersede them)
+            grads = jax.grad(
+                lambda a, b, c: ref_fwd(a, b, c).sum(), (0, 1, 2)
+            )(q, k, v)
+            return sum(g.mean().astype(jnp.float32) for g in grads)
 
         def flash_step(q, k, v):
-            return jax.grad(
+            grads = jax.grad(
                 lambda a, b, c: flash_fwd(a, b, c).sum(), (0, 1, 2)
-            )(q, k, v)[0].mean().astype(jnp.float32)
+            )(q, k, v)
+            return sum(g.mean().astype(jnp.float32) for g in grads)
 
         row = {"seq": seq}
         for name, fn, fwd_only in (

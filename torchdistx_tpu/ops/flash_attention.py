@@ -692,8 +692,16 @@ def _attention_chunk(qc, k, v, bias_rows, row_offset, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+# Benchmarking knob: force the biased backward onto the retired
+# chunked-recompute path so the kernel-vs-chunked delta stays measurable
+# (scripts/bench_flash_attention.py --bias).  Never set in production.
+_FORCE_CHUNKED_BWD = False
+
+
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, bias, out, lse = res
+    if _FORCE_CHUNKED_BWD and bias is not None:
+        return _flash_bwd_chunked(q, k, v, bias, g, causal, scale, block_q)
     # pallas FlashAttention-2 backward (see _flash_backward); with bias a
     # third kernel emits dbias.  _flash_bwd_chunked remains only as the
     # reference implementation the parity tests compare against.
