@@ -4,6 +4,7 @@ ep-sharded == unsharded, gradient flow."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 import torchdistx_tpu as tdx
@@ -129,6 +130,58 @@ class TestCapacityDispatch:
         norms = jnp.linalg.norm(y, axis=-1)
         assert float(jnp.max(norms)) > 0
         assert float(jnp.min(norms)) == 0.0
+
+    def test_gather_dispatch_matches_einsum(self):
+        # gather mode removes the O(n*E*C*D) bookkeeping MACs; outputs and
+        # gradients must agree with the einsum path — including under
+        # tight capacity, where both must drop the SAME tokens (shared
+        # GShard slot assignment)
+        for cf in (2.0, 0.5):
+            tdx.manual_seed(9)
+            a = tdx.deferred_init(
+                MoE, 16, 32, 4, 2, capacity_factor=cf
+            )
+            tdx.materialize_module(a)
+            params = dict(a.named_parameters())
+            b = MoE(
+                16, 32, 4, 2, capacity_factor=cf, dispatch_mode="gather"
+            )
+            b.load_state_dict(params)
+            x = jnp.asarray(
+                np.random.RandomState(4).randn(3, 8, 16).astype(np.float32)
+            )
+            ya, yb = a(x), b(x)
+            np.testing.assert_allclose(
+                np.asarray(ya), np.asarray(yb), rtol=2e-5, atol=2e-5,
+                err_msg=f"capacity_factor={cf}",
+            )
+
+            def loss(p, m):
+                return jnp.mean(functional_call(m, p, (x,)) ** 2)
+
+            ga = jax.grad(lambda p: loss(p, a))(params)
+            gb = jax.grad(lambda p: loss(p, b))(params)
+            for k in ga:
+                np.testing.assert_allclose(
+                    np.asarray(ga[k]), np.asarray(gb[k]),
+                    rtol=2e-4, atol=1e-6,
+                    err_msg=f"grad {k} capacity_factor={cf}",
+                )
+
+    def test_gather_dispatch_jits(self):
+        m = MoE(8, 16, 4, 2, capacity_factor=1.5, dispatch_mode="gather")
+        x = jnp.asarray(np.random.RandomState(5).randn(2, 4, 8).astype(np.float32))
+        y = jax.jit(lambda x: m(x))(x)
+        assert y.shape == x.shape and bool(jnp.all(jnp.isfinite(y)))
+
+    def test_bad_dispatch_mode_rejected(self):
+        with pytest.raises(ValueError, match="dispatch_mode"):
+            MoE(8, 16, 4, 2, dispatch_mode="bogus")
+
+    def test_gather_without_capacity_rejected(self):
+        # silent fallback to dense compute would waste E/top_k x FLOPs
+        with pytest.raises(ValueError, match="capacity_factor"):
+            MoE(8, 16, 4, 2, dispatch_mode="gather")
 
     def test_ep_sharded_dispatch(self):
         mesh = create_mesh({"ep": 4}, devices=jax.devices()[:4])

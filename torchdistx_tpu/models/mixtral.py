@@ -36,6 +36,9 @@ class MixtralConfig(LlamaConfig):
     # None = dense compute (every expert, masked combine — exact);
     # a float enables GShard capacity dispatch (see nn.moe)
     capacity_factor: Optional[float] = None
+    # "einsum" (GSPMD-partitionable) or "gather" (no bookkeeping MACs —
+    # the single-chip fast path); see nn.moe's module docstring
+    moe_dispatch: str = "einsum"
 
 
 mixtral_configs = {
@@ -66,6 +69,7 @@ class MixtralBlock(LlamaBlock):
                 top_k=cfg.top_k,
                 dtype=cfg.dtype,
                 capacity_factor=cfg.capacity_factor,
+                dispatch_mode=cfg.moe_dispatch,
             ),
         )
 

@@ -18,11 +18,24 @@ modes:
     a dispatch tensor and computed as (E, C, D) batches.  FLOPs drop to
     ~``top_k/E`` of dense; tokens beyond an expert's capacity are dropped
     (their combine weight is zero), which is the standard MoE trade.
-    Under an ``ep`` sharding the dispatch/combine einsums become XLA
-    all-to-alls over the expert axis — the TPU-native token shuffle.
 
-With ``capacity_factor >= E / top_k`` no token can be dropped and the two
-modes agree exactly (tested).
+Capacity dispatch itself has two implementations (``dispatch_mode``):
+
+  - "einsum" (default): one-hot (n, E, C) dispatch/combine tensors
+    contracted against the tokens.  Under an ``ep`` sharding these
+    einsums are what GSPMD partitions into all-to-alls over the expert
+    axis — the TPU-native distributed token shuffle — which is why it
+    stays the default.
+  - "gather": the dispatch table is (E, C) token indices and the combine
+    a (n, k) gather of expert outputs — O(E*C*D) data movement instead
+    of the einsums' O(n*E*C*D) MACs, which at typical shapes exceed the
+    expert FFN FLOPs themselves (n=4096, E=8, C=1024, D=4096: 137 GMACs
+    of pure bookkeeping per layer).  Same GShard priority/drop
+    discipline, same expert compute; use it when experts are local
+    (single chip, or inside an explicit shard_map over ``ep``).
+
+With ``capacity_factor >= E / top_k`` no token can be dropped and all
+modes agree (tested).
 """
 
 from __future__ import annotations
@@ -54,15 +67,28 @@ class MoE(Module):
         top_k: int = 2,
         dtype=jnp.float32,
         capacity_factor: Optional[float] = None,
+        dispatch_mode: str = "einsum",
     ) -> None:
         super().__init__()
         if not 1 <= top_k <= n_experts:
             raise ValueError(f"top_k={top_k} out of range for {n_experts} experts")
+        if dispatch_mode not in ("einsum", "gather"):
+            raise ValueError(
+                f"dispatch_mode {dispatch_mode!r} (expected 'einsum' or "
+                "'gather')"
+            )
+        if dispatch_mode == "gather" and capacity_factor is None:
+            raise ValueError(
+                "dispatch_mode='gather' requires capacity_factor: dense "
+                "compute (capacity_factor=None) has no dispatch step for "
+                "the gather path to replace"
+            )
         self.dim = dim
         self.ffn_dim = ffn_dim
         self.n_experts = n_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        self.dispatch_mode = dispatch_mode
         self.router = Linear(dim, n_experts, bias=False, dtype=dtype)
         bound = math.sqrt(1.0 / dim)
         self.w_gate = Parameter(
@@ -108,11 +134,38 @@ class MoE(Module):
         expert_out = jnp.einsum("...ef,efd->...ed", h, self.w_down)
         return jnp.einsum("...e,...ed->...d", combine.astype(x.dtype), expert_out)
 
+    def _capacity_slots(self, pf, cap):
+        """GShard slot assignment shared by both dispatch modes: for each
+        of the k routing choices, the chosen expert, the token's slot in
+        that expert's capacity, the keep mask, and the combine weight.
+        Priority runs top-1 slots before top-2 across all tokens, then by
+        token order — the standard GShard discipline."""
+        e, k = self.n_experts, self.top_k
+        top_p, top_i = jax.lax.top_k(pf, k)
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        slots = []
+        counts = jnp.zeros((e,), jnp.int32)
+        for j in range(k):  # static, small
+            oh = jax.nn.one_hot(top_i[:, j], e, dtype=jnp.int32)  # (n, E)
+            pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]  # (n, E)
+            pos_t = jnp.sum(oh * pos, axis=-1)  # (n,) position in expert
+            keep = pos_t < cap
+            slots.append((top_i[:, j], pos_t, keep, top_p[:, j]))
+            counts = counts + jnp.sum(oh, axis=0)
+        return slots
+
+    def _experts(self, expert_in):
+        """(E, C, D) -> (E, C, D): the SwiGLU expert FFNs, shared by both
+        dispatch modes (MXU-shaped batched matmuls)."""
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, self.w_gate)
+        ) * jnp.einsum("ecd,edf->ecf", expert_in, self.w_up)
+        return jnp.einsum("ecf,efd->ecd", h, self.w_down)
+
     def _capacity_forward(self, x, probs):
         """Capacity-based token dispatch (Mesh-TF/Switch): experts compute
-        (E, C, D) gathered batches instead of every token.  Priority runs
-        top-1 slots before top-2 across all tokens, then by token order —
-        the standard GShard discipline."""
+        (E, C, D) gathered batches instead of every token (module
+        docstring; ``dispatch_mode`` picks the implementation)."""
         e, k = self.n_experts, self.top_k
         lead = x.shape[:-1]
         d = x.shape[-1]
@@ -121,35 +174,56 @@ class MoE(Module):
         n = xf.shape[0]
         cap = int(math.ceil(n * k / e * float(self.capacity_factor)))
         cap = min(cap, n)
+        slots = self._capacity_slots(pf, cap)
 
-        top_p, top_i = jax.lax.top_k(pf, k)
-        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        if self.dispatch_mode == "gather":
+            return self._capacity_gather(xf, slots, n, e, cap, lead, d)
 
         dispatch = jnp.zeros((n, e, cap), x.dtype)
         combine = jnp.zeros((n, e, cap), x.dtype)
-        counts = jnp.zeros((e,), jnp.int32)
-        for j in range(k):  # static, small
-            oh = jax.nn.one_hot(top_i[:, j], e, dtype=jnp.int32)  # (n, E)
-            pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]  # (n, E)
-            pos_t = jnp.sum(oh * pos, axis=-1)  # (n,) position in expert
-            keep = pos_t < cap
+        for ei, pos_t, keep, w in slots:
+            oh = jax.nn.one_hot(ei, e, dtype=jnp.int32)  # (n, E)
             slot = jax.nn.one_hot(
                 jnp.where(keep, pos_t, 0), cap, dtype=x.dtype
             )  # (n, C)
             sel = oh.astype(x.dtype) * keep[:, None].astype(x.dtype)
             dispatch = dispatch + sel[:, :, None] * slot[:, None, :]
             combine = combine + (
-                sel * top_p[:, j][:, None].astype(x.dtype)
+                sel * w[:, None].astype(x.dtype)
             )[:, :, None] * slot[:, None, :]
-            counts = counts + jnp.sum(oh, axis=0)
 
         # (n, E, C) x (n, D) -> (E, C, D): the all-to-all under ep sharding
         expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
-        h = jax.nn.silu(
-            jnp.einsum("ecd,edf->ecf", expert_in, self.w_gate)
-        ) * jnp.einsum("ecd,edf->ecf", expert_in, self.w_up)
-        expert_out = jnp.einsum("ecf,efd->ecd", h, self.w_down)
+        expert_out = self._experts(expert_in)
         y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        return y.reshape(*lead, d)
+
+    def _capacity_gather(self, xf, slots, n, e, cap, lead, d):
+        """Gather/scatter dispatch: same math as the einsum path with the
+        bookkeeping MACs removed.  The dispatch table is (E*C,) token
+        indices (scatter, overflow dropped via out-of-bounds index), the
+        combine a per-choice gather of expert outputs weighted by the
+        (zeroed-when-dropped) routing weight — empty slots carry exact
+        zeros so expert compute matches the einsum path bit-for-bit."""
+        dtype = xf.dtype
+        tok_ids = jnp.arange(n, dtype=jnp.int32)
+        slot_token = jnp.zeros((e * cap,), jnp.int32)
+        slot_valid = jnp.zeros((e * cap,), dtype)
+        for ei, pos_t, keep, _ in slots:
+            flat = jnp.where(keep, ei * cap + pos_t, e * cap)  # OOB = drop
+            slot_token = slot_token.at[flat].set(tok_ids, mode="drop")
+            slot_valid = slot_valid.at[flat].set(
+                jnp.ones((n,), dtype), mode="drop"
+            )
+        expert_in = (
+            xf[slot_token] * slot_valid[:, None]
+        ).reshape(e, cap, d)
+        expert_out = self._experts(expert_in).reshape(e * cap, d)
+        y = jnp.zeros((n, d), dtype)
+        for ei, pos_t, keep, w in slots:
+            flat = jnp.where(keep, ei * cap + pos_t, 0)
+            wk = (w.astype(dtype) * keep.astype(dtype))[:, None]
+            y = y + expert_out[flat] * wk
         return y.reshape(*lead, d)
 
     def _balance_loss(self, probs) -> jax.Array:
