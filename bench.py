@@ -96,7 +96,7 @@ def _train_throughput():
         "mfu": round(mfu, 4),
         "flash_attention": True,
         "remat": w["remat"],  # what the workload actually built
-        "optimizer": "anyprecision_adamw",
+        "optimizer": w["optimizer"],
     }
 
 

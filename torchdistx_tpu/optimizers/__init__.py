@@ -1,3 +1,10 @@
 from .anyprecision_optimizer import AnyPrecisionAdamW, anyprecision_adamw
+from .quantized import adamw_8bit, blockwise_dequantize, blockwise_quantize
 
-__all__ = ["AnyPrecisionAdamW", "anyprecision_adamw"]
+__all__ = [
+    "AnyPrecisionAdamW",
+    "anyprecision_adamw",
+    "adamw_8bit",
+    "blockwise_quantize",
+    "blockwise_dequantize",
+]

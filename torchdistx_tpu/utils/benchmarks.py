@@ -5,7 +5,9 @@
 builder keeps "what we profile" identical to "what we score".
 
 Env overrides (smoke tests / experiments): ``TDX_BENCH_TRAIN_MODEL``,
-``TDX_BENCH_BATCH``, ``TDX_BENCH_SEQ``, ``TDX_BENCH_REMAT``.
+``TDX_BENCH_BATCH``, ``TDX_BENCH_SEQ``, ``TDX_BENCH_REMAT``,
+``TDX_BENCH_OPT`` ("anyprecision" default; "8bit" =
+``optimizers.adamw_8bit`` — the optimizer-HBM-traffic A/B).
 """
 
 from __future__ import annotations
@@ -99,7 +101,18 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
     params = dict(model.named_parameters())
     n_params = model.num_params()
 
-    tx = anyprecision_adamw(1e-4)
+    # TDX_BENCH_OPT=8bit swaps in the blockwise-quantized moments
+    # (optimizers.adamw_8bit) — the optimizer-HBM-traffic A/B: ~3x fewer
+    # optimizer bytes/step against AnyPrecision's f32 m + bf16 v.
+    opt_name = os.environ.get("TDX_BENCH_OPT", "anyprecision")
+    if opt_name == "8bit":
+        from ..optimizers import adamw_8bit
+
+        tx = adamw_8bit(1e-4)
+        opt_label = "adamw_8bit"
+    else:
+        tx = anyprecision_adamw(1e-4)
+        opt_label = "anyprecision_adamw"
     opt_state = tx.init(params)
 
     cfg = llama_configs[name]
@@ -139,4 +152,5 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
         "seq": seq,
         "flops_per_token": flops_per_token,
         "remat": remat,
+        "optimizer": opt_label,
     }
