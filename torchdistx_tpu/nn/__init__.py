@@ -14,6 +14,7 @@ from .layers import (
     SiLU,
 )
 from .module import Buffer, Module, Parameter, functional_call
+from .quantization import QuantizedLinear, QuantizedMoE, quantize_module
 
 __all__ = [
     "functional",
@@ -23,6 +24,9 @@ __all__ = [
     "Buffer",
     "functional_call",
     "Linear",
+    "QuantizedLinear",
+    "QuantizedMoE",
+    "quantize_module",
     "Embedding",
     "LayerNorm",
     "RMSNorm",

@@ -228,6 +228,13 @@ class Module:
                     f"Module.to: {bad[0]!r} is a fake array; materialize "
                     "first (or materialize directly into a sharding)"
                 )
+        # entries a module declares in ``_keep_dtype`` (quantization
+        # scales, ...) are never dtype-cast: their precision is an
+        # invariant of the owning module, not a compute preference
+        keep_dtype: set = set()
+        for mpath, mod in self.named_modules():
+            for name in getattr(mod, "_keep_dtype", ()):
+                keep_dtype.add(f"{mpath}.{name}" if mpath else name)
         staged: dict[str, Any] = {}
         for path, value in entries.items():
             new = value
@@ -235,6 +242,7 @@ class Module:
                 dtype is not None
                 and new.dtype != dtype
                 and jnp.issubdtype(new.dtype, jnp.floating)
+                and path not in keep_dtype
             ):
                 new = new.astype(dtype)
             if sharding is not None:

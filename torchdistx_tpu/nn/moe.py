@@ -127,12 +127,17 @@ class MoE(Module):
         # combine weights as a dense (..., E) mask — partition-friendly
         onehot = jax.nn.one_hot(top_i, self.n_experts, dtype=probs.dtype)
         combine = jnp.einsum("...k,...ke->...e", top_p, onehot)
+        expert_out = self._dense_ffn(x)
+        return jnp.einsum("...e,...ed->...d", combine.astype(x.dtype), expert_out)
 
+    def _dense_ffn(self, x):
+        """(..., D) -> (..., E, D): every expert's FFN on every token —
+        the overridable compute hook of the dense path (the capacity
+        path's analog is :meth:`_experts`)."""
         h_gate = jnp.einsum("...d,edf->...ef", x, self.w_gate)
         h_up = jnp.einsum("...d,edf->...ef", x, self.w_up)
         h = jax.nn.silu(h_gate) * h_up
-        expert_out = jnp.einsum("...ef,efd->...ed", h, self.w_down)
-        return jnp.einsum("...e,...ed->...d", combine.astype(x.dtype), expert_out)
+        return jnp.einsum("...ef,efd->...ed", h, self.w_down)
 
     def _capacity_slots(self, pf, cap):
         """GShard slot assignment shared by both dispatch modes: for each
