@@ -395,3 +395,35 @@ class TestAdam8bit:
             )
         )
         assert chex_like
+
+    def test_state_shardings_helper(self):
+        # ZeRO-style placement: code/scale arrays shard their leading
+        # n_blocks dim over the axis when divisible, else replicate
+        import jax
+
+        from torchdistx_tpu.optimizers import (
+            adam8bit_state_shardings,
+            adamw_8bit,
+        )
+        from torchdistx_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"fsdp": 8})
+        tx = adamw_8bit(1e-3)
+        p = {"w": jnp.zeros((4096, 64)), "b": jnp.zeros((17,))}
+        s = tx.init(p)
+        shardings = adam8bit_state_shardings(s, mesh)
+        placed = jax.device_put(s, shardings)
+        # w: 4096*64/256 = 1024 blocks -> sharded; b: 1 block -> replicated
+        big = [x for x in placed.m_codes if x.shape[0] % 8 == 0]
+        assert all(x.sharding.spec[0] == "fsdp" for x in big)
+        # non-divisible n_blocks falls back to the (always power-of-2)
+        # block dim instead of silently replicating
+        small = [x for x in placed.m_codes if x.shape[0] % 8 != 0]
+        assert all(
+            len(x.sharding.spec) >= 2 and x.sharding.spec[1] == "fsdp"
+            for x in small
+        )
+        # a quantized update runs on the placed state
+        g = jax.tree_util.tree_map(jnp.ones_like, p)
+        u, s2 = tx.update(g, placed, p)
+        assert int(s2.count) == 1

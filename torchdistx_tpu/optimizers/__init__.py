@@ -1,10 +1,16 @@
 from .anyprecision_optimizer import AnyPrecisionAdamW, anyprecision_adamw
-from .quantized import adamw_8bit, blockwise_dequantize, blockwise_quantize
+from .quantized import (
+    adam8bit_state_shardings,
+    adamw_8bit,
+    blockwise_dequantize,
+    blockwise_quantize,
+)
 
 __all__ = [
     "AnyPrecisionAdamW",
     "anyprecision_adamw",
     "adamw_8bit",
+    "adam8bit_state_shardings",
     "blockwise_quantize",
     "blockwise_dequantize",
 ]

@@ -33,6 +33,7 @@ __all__ = [
     "blockwise_quantize",
     "blockwise_dequantize",
     "adamw_8bit",
+    "adam8bit_state_shardings",
 ]
 
 
@@ -103,8 +104,9 @@ class Adam8bitState(NamedTuple):
     params-structured subtrees and imposes the PARAMETER shardings on
     them, which is wrong for the reshaped (n_blocks, block) code
     geometry — flat lists fall through to its replicated default, which
-    is always correct.  (Sharding codes along their leading block dim for
-    true ZeRO-style placement is possible future work.)"""
+    is always correct.  For true ZeRO-style placement shard the codes
+    along their leading block dim with
+    :func:`adam8bit_state_shardings`."""
 
     count: jax.Array
     m_codes: list
@@ -193,3 +195,43 @@ def adamw_8bit(
         return updates, new_state
 
     return optax.GradientTransformation(init, update)
+
+
+def adam8bit_state_shardings(state, mesh, axis: str = "fsdp"):
+    """ZeRO-style placement for an :class:`Adam8bitState`: shard every
+    code/scale array's leading ``n_blocks`` dim over ``axis`` when
+    divisible, else replicate.
+
+    ``parallel.fsdp.optimizer_state_shardings`` replicates these arrays
+    (they are deliberately not params-structured — see the state class
+    docstring); pass this helper's output as the explicit
+    ``out_shardings`` / ``device_put`` target when the moment state
+    should be sharded like ZeRO partitions optimizer state.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def sh(x):
+        if not hasattr(x, "shape"):
+            return NamedSharding(mesh, P())
+        if x.ndim >= 1 and x.shape[0] % n == 0:
+            return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        # n_blocks not divisible (e.g. GPT-2's embedding -> 150771
+        # blocks on an 8-way mesh): fall back to the block dim, which is
+        # block_size (a power of two) for codes and divisible whenever
+        # the axis is — otherwise the model's largest moment arrays
+        # would silently replicate
+        if x.ndim >= 2 and x.shape[1] % n == 0:
+            return NamedSharding(
+                mesh, P(None, axis, *([None] * (x.ndim - 2)))
+            )
+        return NamedSharding(mesh, P())
+
+    return Adam8bitState(
+        count=NamedSharding(mesh, P()),
+        m_codes=[sh(x) for x in state.m_codes],
+        m_scales=[sh(x) for x in state.m_scales],
+        v_codes=[sh(x) for x in state.v_codes],
+        v_scales=[sh(x) for x in state.v_scales],
+    )
