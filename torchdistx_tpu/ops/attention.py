@@ -145,6 +145,28 @@ def multihead_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+
+def _ring_bias_block(bias, j, skv):
+    """The held block's bias columns: shard j's keys occupy global columns
+    [j * skv, (j + 1) * skv).  One definition for the jnp ring and both
+    flash-ring passes, so the hop->column mapping can never desynchronize
+    between the reference and kernel paths."""
+    if bias is None:
+        return None
+    return lax.dynamic_slice_in_dim(bias, j * skv, skv, axis=2)
+
+
+def _validate_ring_bias(name, bias, hq, sq, n, skv):
+    if bias is not None and bias.shape != (hq, sq, n * skv):
+        # dynamic_slice would CLAMP a too-short key dim (e.g. a bias
+        # mistakenly sharded on its key axis) into silently wrong logits
+        raise ValueError(
+            f"{name} bias shape {bias.shape} != (H, sq_local, "
+            f"S_global) = {(hq, sq, n * skv)} — keep the key dim of the "
+            "bias UNsharded (in_specs P(None, axis, None))"
+        )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -178,14 +200,7 @@ def ring_attention(
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
-    if bias is not None and bias.shape != (hq, sq, n * skv):
-        # dynamic_slice would CLAMP a too-short key dim (e.g. a bias
-        # mistakenly sharded on its key axis) into silently wrong logits
-        raise ValueError(
-            f"ring_attention bias shape {bias.shape} != (H, sq_local, "
-            f"S_global) = {(hq, sq, n * skv)} — keep the key dim of the "
-            "bias UNsharded (in_specs P(None, axis, None))"
-        )
+    _validate_ring_bias("ring_attention", bias, hq, sq, n, skv)
     # GQA: keep K/V at hkv heads while they travel the ring (1/n_rep the
     # ppermute bytes — the whole point of GQA on the long-context path) and
     # broadcast over query-head groups only inside each local block step.
@@ -205,9 +220,7 @@ def ring_attention(
             * scale_
         )
         if bias is not None:
-            # the block we hold is shard j's keys: global columns
-            # [j * skv, (j + 1) * skv)
-            bias_blk = lax.dynamic_slice_in_dim(bias, j * skv, skv, axis=2)
+            bias_blk = _ring_bias_block(bias, j, skv)
             logits = logits + bias_blk[None].astype(jnp.float32)
         if causal:
             visible = jnp.where(
@@ -278,7 +291,8 @@ def _ring_combine(acc, m, l, raw_j, m_j, l_j):
 
 
 def _ring_bwd_block(
-    prep, khb, vhb, *, b, hq, hkv, diag, scale, block_q, block_k, interpret
+    prep, khb, vhb, bias_blk, *,
+    b, hq, hkv, diag, scale, block_q, block_k, interpret,
 ):
     """Gradient contributions of one held K/V block, via the pallas
     FlashAttention-2 backward kernels seeded with the GLOBAL row LSE —
@@ -288,8 +302,11 @@ def _ring_bwd_block(
     loop-invariant operand tuple (``_prepare_flash_bwd``); K/V arrive and
     gradients leave HEAD-MAJOR, matching the ring carry.  ``diag``
     applies the local causal mask (static per cond-branch); contributions
-    accumulate across hops in f32."""
-    from .flash_attention import _flash_backward_core
+    accumulate across hops in f32.  With ``bias_blk`` (this block's
+    column slice) the kernels stream the bias and a dbias slice is
+    returned (each device owns its query rows' bias gradient — no
+    cross-device reduction)."""
+    from .flash_attention import _flash_backward_core, _flash_dbias
 
     qh, doh, oh, lse_b = prep
     dqh, dk_part, dv_part = _flash_backward_core(
@@ -298,6 +315,7 @@ def _ring_bwd_block(
         causal=diag, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
         dq_dtype=jnp.float32, part_dtype=jnp.float32,
+        bias=bias_blk,
     )
     n_rep = hq // hkv
     if n_rep > 1:
@@ -309,18 +327,30 @@ def _ring_bwd_block(
         dv_part = (
             dv_part.reshape(b, hkv, n_rep, skv, d).sum(2).reshape(-1, skv, d)
         )
-    return dqh, dk_part, dv_part
+    db_blk = None
+    if bias_blk is not None:
+        db_blk = _flash_dbias(
+            qh, doh, oh, lse_b, khb, vhb, bias_blk,
+            b=b, hq=hq, hkv=hkv,
+            causal=diag, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        ).astype(jnp.float32)
+    return dqh, dk_part, dv_part, db_blk
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash_vjp(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash_vjp(
+    q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
+):
     out, _ = _ring_flash_fwd(
-        q, k, v, axis, causal, scale, block_q, block_k, interpret
+        q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
     )
     return out
 
 
-def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+def _ring_flash_fwd(
+    q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
+):
     from .flash_attention import _flash_forward
 
     n = lax.axis_size(axis)
@@ -344,8 +374,11 @@ def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
         def make_branch(diag_mask):
             def branch(ops):
                 a, mm, ll = ops
+                # O(S) bias per device total (_ring_bias_block)
+                blk_bias = _ring_bias_block(bias, j, skv)
                 return _ring_combine(
-                    a, mm, ll, *flash(q, kb, vb, causal=diag_mask)
+                    a, mm, ll,
+                    *flash(q, kb, vb, causal=diag_mask, bias=blk_bias),
                 )
 
             return branch
@@ -378,18 +411,18 @@ def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k, interpret):
 
 
 def _ring_flash_fwd_rule(
-    q, k, v, axis, causal, scale, block_q, block_k, interpret
+    q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
 ):
     out, lse = _ring_flash_fwd(
-        q, k, v, axis, causal, scale, block_q, block_k, interpret
+        q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _ring_flash_bwd_rule(
     axis, causal, scale, block_q, block_k, interpret, res, g
 ):
-    q, k, v, out, lse = res
+    q, k, v, bias, out, lse = res
     from .flash_attention import _prepare_flash_bwd
 
     n = lax.axis_size(axis)
@@ -408,34 +441,41 @@ def _ring_flash_bwd_rule(
     vh = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, skv, d)
 
     def step(carry, _):
-        dq, kb, vb, dkb, dvb, j = carry
+        dq, db, kb, vb, dkb, dvb, j = carry
 
         def make_branch(diag_mask):
             def branch(ops):
-                dq_, dkb_, dvb_, kb_, vb_ = ops
-                dq_c, dk_c, dv_c = _ring_bwd_block(
-                    prep, kb_, vb_,
+                dq_, db_, dkb_, dvb_, kb_, vb_ = ops
+                bias_blk = _ring_bias_block(bias, j, skv)
+                dq_c, dk_c, dv_c, db_c = _ring_bwd_block(
+                    prep, kb_, vb_, bias_blk,
                     b=b, hq=hq, hkv=hkv,
                     diag=diag_mask, scale=scale_,
                     block_q=block_q, block_k=block_k, interpret=interpret,
                 )
-                return dq_ + dq_c, dkb_ + dk_c, dvb_ + dv_c
+                if db_c is not None:
+                    # each column block visits this device exactly once,
+                    # so the slice write is the whole contribution
+                    db_ = lax.dynamic_update_slice_in_dim(
+                        db_, db_c, j * skv, axis=2
+                    )
+                return dq_ + dq_c, db_, dkb_ + dk_c, dvb_ + dv_c
 
             return branch
 
         full, diag = make_branch(False), make_branch(True)
-        ops = (dq, dkb, dvb, kb, vb)
+        ops = (dq, db, dkb, dvb, kb, vb)
         if causal:
-            dq, dkb, dvb = lax.cond(
+            dq, db, dkb, dvb = lax.cond(
                 j == idx,
                 diag,
                 lambda o: lax.cond(
-                    j < idx, full, lambda o_: (o_[0], o_[1], o_[2]), o
+                    j < idx, full, lambda o_: (o_[0], o_[1], o_[2], o_[3]), o
                 ),
                 ops,
             )
         else:
-            dq, dkb, dvb = full(ops)
+            dq, db, dkb, dvb = full(ops)
         # gradient buffers travel WITH their K/V blocks: after n hops both
         # land back on the owning device with all contributions summed
         kb = lax.ppermute(kb, axis, perm)
@@ -443,18 +483,31 @@ def _ring_flash_bwd_rule(
         dkb = lax.ppermute(dkb, axis, perm)
         dvb = lax.ppermute(dvb, axis, perm)
         j = lax.ppermute(j, axis, perm)
-        return (dq, kb, vb, dkb, dvb, j), None
+        return (dq, db, kb, vb, dkb, dvb, j), None
 
     dq0 = jnp.zeros((b * hq, sq, d), jnp.float32)
+    # bias grad is per-device query rows x ALL key columns — O(S), the
+    # same layout as the bias input; a scalar placeholder when bias-free
+    db0 = (
+        jnp.zeros((hq, sq, n * skv), jnp.float32)
+        if bias is not None
+        else jnp.zeros((), jnp.float32)
+    )
     dk0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
     dv0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
-    (dqh, _, _, dkh, dvh, _), _ = lax.scan(
-        step, (dq0, kh, vh, dk0, dv0, idx), None, length=n
+    (dqh, dbh, _, _, dkh, dvh, _), _ = lax.scan(
+        step, (dq0, db0, kh, vh, dk0, dv0, idx), None, length=n
     )
     dq = jnp.transpose(dqh.reshape(b, hq, sq, d), (0, 2, 1, 3))
     dk = jnp.transpose(dkh.reshape(b, hkv, skv, d), (0, 2, 1, 3))
     dv = jnp.transpose(dvh.reshape(b, hkv, skv, d), (0, 2, 1, 3))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dbias = dbh.astype(bias.dtype) if bias is not None else None
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        dbias,
+    )
 
 
 _ring_flash_vjp.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
@@ -468,6 +521,7 @@ def ring_flash_attention(
     axis: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
     block_q: int = 256,
     block_k: int = 512,
     interpret: Optional[bool] = None,
@@ -480,8 +534,14 @@ def ring_flash_attention(
     flash kernel instead of materializing an (sq x skv) f32 logits
     matrix — per-device memory stays flat as shard sizes grow, which is
     what makes pod-scale long context (8k+ per shard) trainable.
-    Additive bias is not supported on this path (use ``ring_attention``;
-    T5's relative-position bias needs per-hop bias slicing).
+
+    ``bias``: optional additive logit bias of shape
+    (H, sq_local, S_global) — this shard's global query rows against ALL
+    key positions (T5's relative-position bias under sequence
+    parallelism, same layout as :func:`ring_attention`).  Each hop
+    streams the held block's column slice into the kernels; the backward
+    emits the dbias slice this device's query rows own (no cross-device
+    reduction).
 
     Differentiable via a whole-ring custom VJP: backward is a second ring
     pass with the saved global LSE; dK/dV accumulators rotate with their
@@ -493,10 +553,15 @@ def ring_flash_attention(
             "causal ring attention requires equal per-shard query and key "
             f"lengths, got {q.shape[1]} vs {k.shape[1]}"
         )
+    if bias is not None:
+        _validate_ring_bias(
+            "ring_flash_attention", bias, q.shape[2], q.shape[1],
+            lax.axis_size(axis), k.shape[1],
+        )
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _ring_flash_vjp(
-        q, k, v, axis, causal, scale, block_q, block_k, interpret
+        q, k, v, bias, axis, causal, scale, block_q, block_k, interpret
     )
 
 
