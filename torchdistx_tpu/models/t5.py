@@ -40,6 +40,13 @@ class T5Config:
     # bucket bias in-kernel from the (buckets, H) table would; until
     # then, very long T5 contexts should use sequence parallelism.
     use_flash: object = None
+    # Sequence parallelism: shard the sequence dim over this mesh axis
+    # (run the model inside shard_map, tokens P(None, sp_axis)).  Self-
+    # attention rides the RING (flash kernels when use_flash resolves on)
+    # with the relative-position bias sliced per device (O(S) rows);
+    # cross-attention rings over the encoder's key shards.  Training /
+    # encoding only — cached generation runs unsharded.
+    sp_axis: object = None
 
 
 t5_configs = {
@@ -88,11 +95,13 @@ class T5Attention(nn.Module):
         else:
             self.rel_bias = None
 
-    def _bias(self, sq: int, skv: int):
+    def _bias(self, sq: int, skv: int, q_offset=0):
+        """(H, sq, skv) relative-position bias for query rows starting at
+        global position ``q_offset`` (0 for the unsharded path)."""
         if self.rel_bias is None:
             return None
         cfg = self.cfg
-        ctx = jnp.arange(sq)[:, None]
+        ctx = q_offset + jnp.arange(sq)[:, None]
         mem = jnp.arange(skv)[None, :]
         bucket = _rel_pos_bucket(
             mem - ctx,
@@ -101,6 +110,18 @@ class T5Attention(nn.Module):
             max_dist=cfg.rel_pos_max_dist,
         )
         return jnp.transpose(self.rel_bias(bucket), (2, 0, 1))  # (H, Sq, Skv)
+
+    def _bias_sp(self, sq: int):
+        """Sequence-parallel bias slice: THIS device's global query rows
+        (shard ``axis_index``) against ALL key positions — the ring
+        paths' (H, sq_local, S_global) layout, O(S) per device."""
+        if self.rel_bias is None:
+            return None
+        axis = self.cfg.sp_axis
+        n = jax.lax.axis_size(axis)
+        return self._bias(
+            sq, n * sq, q_offset=jax.lax.axis_index(axis) * sq
+        )
 
     def forward_cached_self(self, x, cache, cache_pos, bias):
         """Incremental causal self-attention against a (k, v) cache.
@@ -139,6 +160,27 @@ class T5Attention(nn.Module):
         q = self.q(x).reshape(b, sq, cfg.n_heads, cfg.d_kv)
         k = self.k(kv).reshape(b, skv, cfg.n_heads, cfg.d_kv)
         v = self.v(kv).reshape(b, skv, cfg.n_heads, cfg.d_kv)
+        if cfg.sp_axis is not None:
+            # sequence-parallel ring (config docstring): the shared-bias
+            # plumbing carries each device's (H, sq_local, S_global)
+            # slice; cross-attention rings over encoder key shards
+            from ..ops.attention import ring_attention, ring_flash_attention
+
+            if is_self and bias is None and self.rel_bias is not None:
+                bias = self._bias_sp(sq)
+            ring = (
+                ring_flash_attention
+                if resolve_use_flash(cfg.use_flash)
+                else ring_attention
+            )
+            out = ring(
+                q, k, v, axis=cfg.sp_axis, causal=causal,
+                scale=1.0, bias=bias if is_self else None,
+            )
+            return (
+                self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)),
+                bias,
+            )
         if bias is None and self.rel_bias is not None:
             bias = self._bias(sq, skv)
         # T5 uses unscaled dot products (scale folded into init)
