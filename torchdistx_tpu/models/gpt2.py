@@ -34,6 +34,18 @@ class GPT2Config:
     # 2-5x and the only path at 8k+, scripts/bench_flash_attention.py),
     # off elsewhere (interpret-mode pallas is exact but slow on CPU)
     use_flash: object = None
+    # Sequence parallelism (mirrors Llama): shard the sequence over this
+    # mesh axis and run the model inside shard_map (tokens P(None, sp));
+    # learned positions offset by the shard index.  sp_mode: "ring"
+    # (flash kernels when use_flash resolves on) or "ulysses".
+    sp_axis: object = None
+    sp_mode: str = "ring"
+
+    def __post_init__(self) -> None:
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got {self.sp_mode!r}"
+            )
 
 
 gpt2_configs = {
@@ -57,6 +69,8 @@ class GPT2Block(nn.Module):
     def __init__(self, cfg: GPT2Config):
         super().__init__()
         self.use_flash = cfg.use_flash
+        self.sp_axis = cfg.sp_axis
+        self.sp_mode = cfg.sp_mode
         d = cfg.dim
         # GPT-2 scheme: N(0, 0.02) weights, zero biases, residual output
         # projections scaled by 1/sqrt(2 * n_layers)
@@ -77,7 +91,14 @@ class GPT2Block(nn.Module):
         h = self.ln1(x)
         qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, d // self.n_heads)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if resolve_use_flash(self.use_flash):
+        if self.sp_axis is not None:
+            from ..ops.attention import sp_attention
+
+            a = sp_attention(
+                q, k, v, axis=self.sp_axis, mode=self.sp_mode,
+                causal=True, use_flash=self.use_flash,
+            ).reshape(b, s, d)
+        elif resolve_use_flash(self.use_flash):
             from ..ops.flash_attention import flash_attention
 
             a = flash_attention(q, k, v, causal=True).reshape(b, s, d)
@@ -121,13 +142,25 @@ class GPT2(nn.Module):
 
     def forward(self, tokens):
         s = tokens.shape[1]
-        if s > self.cfg.n_positions:
+        if self.cfg.sp_axis is not None:
+            import jax
+
+            # s is the LOCAL shard; positions are global (shard offset)
+            n = jax.lax.axis_size(self.cfg.sp_axis)
+            if s * n > self.cfg.n_positions:
+                raise ValueError(
+                    f"global sequence length {s * n} exceeds n_positions="
+                    f"{self.cfg.n_positions}"
+                )
+            pos = jax.lax.axis_index(self.cfg.sp_axis) * s + jnp.arange(s)
+        elif s > self.cfg.n_positions:
             # jnp.take clamps out-of-range indices silently; fail loudly
             raise ValueError(
                 f"sequence length {s} exceeds n_positions="
                 f"{self.cfg.n_positions}"
             )
-        pos = jnp.arange(s)
+        else:
+            pos = jnp.arange(s)
         x = self.tok_emb(tokens) + self.pos_emb(pos)[None]
         for blk in self.blocks:
             x = blk(x)

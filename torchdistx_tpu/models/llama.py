@@ -24,9 +24,7 @@ from ..nn import init as nn_init
 from ..ops.attention import (
     cached_attention,
     multihead_attention,
-    ring_attention,
-    ring_flash_attention,
-    ulysses_attention,
+    sp_attention,
 )
 from ..ops.flash_attention import resolve_use_flash
 
@@ -148,20 +146,13 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, rope, pos_offset)
         k = apply_rope(k, rope, pos_offset)
         if cfg.sp_axis is not None:
-            if cfg.sp_mode == "ulysses":
-                out = ulysses_attention(
-                    q, k, v, axis=cfg.sp_axis, causal=True,
-                    use_flash=cfg.use_flash,
-                )
-            elif resolve_use_flash(cfg.use_flash):
-                # flash kernel per ring block: per-device memory stays
-                # flat as shards grow (8k+/shard trainable), K/V travel
-                # at hkv heads
-                out = ring_flash_attention(
-                    q, k, v, axis=cfg.sp_axis, causal=True
-                )
-            else:
-                out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=True)
+            # ring: flash kernel per block (per-device memory flat as
+            # shards grow, K/V travel at hkv heads) or the jnp ring;
+            # ulysses: all-to-all — one shared dispatcher for all models
+            out = sp_attention(
+                q, k, v, axis=cfg.sp_axis, mode=cfg.sp_mode,
+                causal=True, use_flash=cfg.use_flash,
+            )
         elif resolve_use_flash(cfg.use_flash):
             from ..ops.flash_attention import flash_attention
 

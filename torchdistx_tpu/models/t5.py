@@ -164,18 +164,14 @@ class T5Attention(nn.Module):
             # sequence-parallel ring (config docstring): the shared-bias
             # plumbing carries each device's (H, sq_local, S_global)
             # slice; cross-attention rings over encoder key shards
-            from ..ops.attention import ring_attention, ring_flash_attention
+            from ..ops.attention import sp_attention
 
             if is_self and bias is None and self.rel_bias is not None:
                 bias = self._bias_sp(sq)
-            ring = (
-                ring_flash_attention
-                if resolve_use_flash(cfg.use_flash)
-                else ring_attention
-            )
-            out = ring(
+            out = sp_attention(
                 q, k, v, axis=cfg.sp_axis, causal=causal,
                 scale=1.0, bias=bias if is_self else None,
+                use_flash=cfg.use_flash,
             )
             return (
                 self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)),

@@ -24,6 +24,7 @@ from jax import lax
 
 __all__ = [
     "multihead_attention",
+    "sp_attention",
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",
@@ -615,3 +616,44 @@ def ulysses_attention(
         out = multihead_attention(qg, kg, vg, causal=causal, scale=scale)
     # inverse reshard: (b, s, h/n, d) -> (b, s/n, h, d)
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def sp_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    mode: str = "ring",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """The one sequence-parallel dispatch shared by the model families
+    (Llama/GPT-2/Mixtral/T5): "ring" routes to the flash-backed ring when
+    ``use_flash`` resolves on and the jnp ring otherwise; "ulysses" runs
+    the all-to-all strategy (no bias support — T5 must use the ring).
+    One definition so mode selection, validation, and future parameters
+    can never diverge between models."""
+    from .flash_attention import resolve_use_flash
+
+    if mode == "ulysses":
+        if bias is not None:
+            raise ValueError(
+                "ulysses sequence parallelism does not support an additive "
+                "bias; use mode='ring'"
+            )
+        return ulysses_attention(
+            q, k, v, axis=axis, causal=causal, scale=scale,
+            use_flash=use_flash,
+        )
+    if mode != "ring":
+        raise ValueError(f"sp mode must be 'ring' or 'ulysses', got {mode!r}")
+    if resolve_use_flash(use_flash):
+        return ring_flash_attention(
+            q, k, v, axis=axis, causal=causal, scale=scale, bias=bias
+        )
+    return ring_attention(
+        q, k, v, axis=axis, causal=causal, scale=scale, bias=bias
+    )
