@@ -669,3 +669,117 @@ class TestRingFlashBias:
                 out_specs=P(None, "sp"),
                 check_vma=False,
             )(q, bad)
+
+
+class TestBucketBias:
+    """In-kernel bucket bias: the kernels compute each tile's T5
+    relative-position bias from the (H, buckets) table in VMEM — outputs
+    and ALL gradients (incl. dtable via the fourth kernel) must match the
+    materialized-bias path exactly."""
+
+    @staticmethod
+    def _setup(s=32, h=4, d=16, buckets=32, max_dist=128, key=0):
+        from torchdistx_tpu.ops.flash_attention import rel_pos_bucket
+
+        rs = np.random.RandomState(key)
+        q = jnp.asarray(rs.randn(2, s, h, d), jnp.float32)
+        k = jnp.asarray(rs.randn(2, s, h, d), jnp.float32)
+        v = jnp.asarray(rs.randn(2, s, h, d), jnp.float32)
+        table = jnp.asarray(rs.randn(h, buckets) * 0.5, jnp.float32)
+        return q, k, v, table, rel_pos_bucket
+
+    @pytest.mark.parametrize("bidir,causal", [(False, True), (True, False)])
+    def test_matches_materialized_bias(self, bidir, causal):
+        s, buckets, max_dist = 32, 32, 128
+        q, k, v, table, bucket_fn = self._setup(s=s)
+
+        bucket = bucket_fn(
+            jnp.arange(s)[None, :] - jnp.arange(s)[:, None],
+            bidirectional=bidir, buckets=buckets, max_dist=max_dist,
+        )
+        bias = jnp.transpose(table.T[bucket], (2, 0, 1))
+
+        def ref_loss(q, k, v, t):
+            b_ = jnp.transpose(t.T[bucket], (2, 0, 1))
+            return jnp.sum(flash_attention(
+                q, k, v, bias=b_, causal=causal, block_q=8, block_k=8
+            ).astype(jnp.float32) ** 2)
+
+        def tab_loss(q, k, v, t):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=8, block_k=8,
+                rel_bias_table=t, rel_bias_buckets=buckets,
+                rel_bias_max_dist=max_dist, rel_bias_bidirectional=bidir,
+            ).astype(jnp.float32) ** 2)
+
+        out_ref = flash_attention(
+            q, k, v, bias=bias, causal=causal, block_q=8, block_k=8
+        )
+        out_tab = flash_attention(
+            q, k, v, causal=causal, block_q=8, block_k=8,
+            rel_bias_table=table, rel_bias_buckets=buckets,
+            rel_bias_max_dist=max_dist, rel_bias_bidirectional=bidir,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_tab), np.asarray(out_ref), atol=2e-6
+        )
+        gr = jax.grad(ref_loss, (0, 1, 2, 3))(q, k, v, table)
+        gt = jax.grad(tab_loss, (0, 1, 2, 3))(q, k, v, table)
+        for name, a, b_ in zip(("dq", "dk", "dv", "dtable"), gt, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-5,
+                err_msg=name,
+            )
+
+    def test_t5_flash_bucket_bias_parity(self):
+        from torchdistx_tpu.models import T5
+        from torchdistx_tpu.nn import functional, functional_call
+
+        tdx.manual_seed(15)
+        a = tdx.deferred_init(T5.from_name, "tiny", use_flash=True)
+        tdx.materialize_module(a)
+        params = dict(a.named_parameters())
+        bkt = T5.from_name("tiny", use_flash=True, flash_bucket_bias=True)
+        bkt.load_state_dict(params)
+        rs = np.random.RandomState(12)
+        src = jnp.asarray(rs.randint(0, 256, (2, 32)), jnp.int32)
+        tgt = jnp.asarray(rs.randint(0, 256, (2, 32)), jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(bkt(src, tgt)), np.asarray(a(src, tgt)),
+            rtol=2e-4, atol=2e-4,
+        )
+
+        def loss(m, p):
+            return functional.cross_entropy(
+                functional_call(m, p, (src, tgt)), tgt
+            )
+
+        ga = jax.grad(lambda p: loss(a, p))(params)
+        gb = jax.grad(lambda p: loss(bkt, p))(params)
+        for k_ in ga:
+            np.testing.assert_allclose(
+                np.asarray(gb[k_]), np.asarray(ga[k_]),
+                rtol=5e-4, atol=5e-5, err_msg=k_,
+            )
+
+    def test_rejects_bias_and_table_together(self):
+        q, k, v, table, _ = self._setup()
+        bias = jnp.zeros((4, 32, 32), jnp.float32)
+        with pytest.raises(ValueError, match="not both"):
+            flash_attention(q, k, v, bias=bias, rel_bias_table=table)
+
+    def test_rejects_cross_shape(self):
+        q, k, v, table, _ = self._setup()
+        with pytest.raises(ValueError, match="Sq == Skv"):
+            flash_attention(
+                q[:, :16], k, v, causal=True, rel_bias_table=table
+            )
+
+    def test_bucket_bias_with_sp_rejected(self):
+        from torchdistx_tpu.models import T5
+
+        with pytest.raises(ValueError, match="flash_bucket_bias"):
+            T5.from_name(
+                "tiny", sp_axis="sp", flash_bucket_bias=True,
+                use_flash=True,
+            )

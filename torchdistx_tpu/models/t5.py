@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..ops.attention import cached_attention
-from ..ops.flash_attention import resolve_use_flash
+from ..ops.flash_attention import rel_pos_bucket, resolve_use_flash
 
 __all__ = ["T5Config", "T5", "t5_configs"]
 
@@ -35,11 +35,17 @@ class T5Config:
     # pallas flash attention for SELF-attention (bias streamed into the
     # kernel).  None = auto: on for TPU, off elsewhere (interpret-mode
     # pallas on CPU is exact but slow).  Cross-attention stays einsum.
-    # NOTE: the (H, Sq, Skv) bias itself still materializes in HBM, so
-    # T5 does not inherit flash's O(S) memory ceiling — computing the
-    # bucket bias in-kernel from the (buckets, H) table would; until
-    # then, very long T5 contexts should use sequence parallelism.
+    # NOTE: with flash_bucket_bias off, the (H, Sq, Skv) bias
+    # materializes in HBM and caps single-chip context; turn it on (or
+    # use sequence parallelism) for long contexts.
     use_flash: object = None
+    # In-kernel bucket bias (single-chip long context): self-attention
+    # passes the (H, buckets) table into the flash kernels, which compute
+    # each tile's bias from bucket ids in VMEM — no (H, S, S) bias ever
+    # materializes, restoring flash's O(S) memory for T5.  Requires
+    # use_flash; off by default (compiled-kernel acceptance pending the
+    # next on-chip run; CPU interpret-mode parity is pinned in tests).
+    flash_bucket_bias: bool = False
     # Sequence parallelism: shard the sequence dim over this mesh axis
     # (run the model inside shard_map, tokens P(None, sp_axis)).  Self-
     # attention rides the RING (flash kernels when use_flash resolves on)
@@ -47,6 +53,17 @@ class T5Config:
     # cross-attention rings over the encoder's key shards.  Training /
     # encoding only — cached generation runs unsharded.
     sp_axis: object = None
+
+    def __post_init__(self) -> None:
+        if self.flash_bucket_bias and self.sp_axis is not None:
+            # the SP ring materializes each device's (H, sq_local,
+            # S_global) bias slice; silently dropping to that path would
+            # re-introduce the HBM footprint the flag exists to remove
+            raise ValueError(
+                "flash_bucket_bias is not supported together with "
+                "sp_axis: the ring paths slice a materialized per-device "
+                "bias (O(S) rows) — drop one of the two"
+            )
 
 
 t5_configs = {
@@ -57,27 +74,6 @@ t5_configs = {
     "t5_3b": dict(dim=1024, d_ff=16384, d_kv=128, n_heads=32, n_layers=24),
     "t5_11b": dict(dim=1024, d_ff=65536, d_kv=128, n_heads=128, n_layers=24),
 }
-
-
-def _rel_pos_bucket(rel_pos, *, bidirectional: bool, buckets: int, max_dist: int):
-    """T5's relative-position bucketing (log-spaced beyond buckets/2)."""
-    ret = 0
-    n = -rel_pos
-    if bidirectional:
-        buckets = buckets // 2
-        ret = jnp.where(n < 0, buckets, 0)
-        n = jnp.abs(n)
-    else:
-        n = jnp.maximum(n, 0)
-    max_exact = buckets // 2
-    is_small = n < max_exact
-    log_big = max_exact + (
-        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
-        / jnp.log(max_dist / max_exact)
-        * (buckets - max_exact)
-    ).astype(jnp.int32)
-    log_big = jnp.minimum(log_big, buckets - 1)
-    return ret + jnp.where(is_small, n, log_big)
 
 
 class T5Attention(nn.Module):
@@ -103,7 +99,7 @@ class T5Attention(nn.Module):
         cfg = self.cfg
         ctx = q_offset + jnp.arange(sq)[:, None]
         mem = jnp.arange(skv)[None, :]
-        bucket = _rel_pos_bucket(
+        bucket = rel_pos_bucket(
             mem - ctx,
             bidirectional=self.bidirectional,
             buckets=cfg.rel_pos_buckets,
@@ -176,6 +172,30 @@ class T5Attention(nn.Module):
             return (
                 self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)),
                 bias,
+            )
+        use_bucket = (
+            is_self
+            and cfg.flash_bucket_bias
+            and resolve_use_flash(cfg.use_flash)
+        )
+        if use_bucket:
+            # the shared "bias" object is the (H, buckets) TABLE in this
+            # mode — layer 0 extracts it, later layers reuse it
+            from ..ops.flash_attention import flash_attention
+
+            table = bias
+            if table is None and self.rel_bias is not None:
+                table = jnp.transpose(self.rel_bias.weight)
+            out = flash_attention(
+                q, k, v, causal=causal, scale=1.0,
+                rel_bias_table=table,
+                rel_bias_buckets=cfg.rel_pos_buckets,
+                rel_bias_max_dist=cfg.rel_pos_max_dist,
+                rel_bias_bidirectional=self.bidirectional,
+            )
+            return (
+                self.o(out.reshape(b, sq, cfg.n_heads * cfg.d_kv)),
+                table,
             )
         if bias is None and self.rel_bias is not None:
             bias = self._bias(sq, skv)
@@ -310,7 +330,7 @@ class T5(nn.Module):
         layer0 = self.dec_blocks[0].self_attn
         ctx = (cache_pos + jnp.arange(sq))[:, None]
         mem = jnp.arange(max_seq)[None, :]
-        bucket = _rel_pos_bucket(
+        bucket = rel_pos_bucket(
             mem - ctx,
             bidirectional=False,
             buckets=self.cfg.rel_pos_buckets,

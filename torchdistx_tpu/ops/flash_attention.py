@@ -26,10 +26,65 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "rel_pos_bucket"]
 
 _NEG_INF = -1e30
 _RES_LANES = 128  # TPU lane width: residual (m, l) rows broadcast over it
+
+
+def rel_pos_bucket(rel_pos, *, bidirectional: bool, buckets: int, max_dist: int):
+    """T5's relative-position bucketing (log-spaced beyond buckets/2).
+
+    Pure jnp on any integer array — shared by the T5 model (host-side
+    bias materialization) and the flash kernels' in-kernel bucket-bias
+    tiles, so the two bias sources can never diverge."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        buckets = buckets // 2
+        ret = jnp.where(n < 0, buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = buckets // 2
+    is_small = n < max_exact
+    log_big = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_dist / max_exact)
+        * (buckets - max_exact)
+    ).astype(jnp.int32)
+    log_big = jnp.minimum(log_big, buckets - 1)
+    return ret + jnp.where(is_small, n, log_big)
+
+
+def _bucket_bias_tile(table_ref, qi, ki, *, block_q, block_k, bucket_cfg):
+    """(block_q, block_k) f32 bias tile computed IN-KERNEL from the
+    per-head bucket table (``table_ref``: (1, buckets) VMEM block).
+
+    The bucket ids come from the tile's global (row, col) offsets; the
+    table lookup is a static loop of ``buckets`` selects against scalar
+    reads — VPU work linear in the tile size, no (H, S, S) bias in HBM.
+    Requires sq == skv (training shapes): bucket positions are
+    start-aligned."""
+    buckets, max_dist, bidirectional = bucket_cfg
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    bucket = rel_pos_bucket(
+        cols - rows,
+        bidirectional=bidirectional,
+        buckets=buckets,
+        max_dist=max_dist,
+    )
+    bias = jnp.zeros((block_q, block_k), jnp.float32)
+    for b in range(buckets):  # static, small (32 for T5)
+        bias = bias + jnp.where(
+            bucket == b, table_ref[0, b].astype(jnp.float32), 0.0
+        )
+    return bias
 
 
 def _shrink_block(block: int, s: int) -> int:
@@ -55,6 +110,7 @@ def _kernel(
     has_bias: bool,
     emit_residuals: bool = False,
     emit_lse: bool = False,
+    bucket_cfg=None,
 ):
     rest = list(rest)
     bias_ref = rest.pop(0) if has_bias else None
@@ -96,7 +152,14 @@ def _kernel(
             * scale
         )  # (block_q, block_k)
         if has_bias:
-            logits = logits + bias_ref[0].astype(jnp.float32)
+            if bucket_cfg is not None:
+                logits = logits + _bucket_bias_tile(
+                    bias_ref, qi, ki,
+                    block_q=block_q, block_k=block_k,
+                    bucket_cfg=bucket_cfg,
+                )
+            else:
+                logits = logits + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = (
                 qi * block_q
@@ -149,6 +212,7 @@ def _kernel(
 def _bwd_recompute(
     q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref, *,
     scale, causal, block_q, block_k, qi, kk, diag_offset,
+    bucket_cfg=None,
 ):
     """Shared backward-body recompute: reconstitute this tile's
     probabilities from the saved lse and form the dS ingredients.
@@ -174,7 +238,13 @@ def _bwd_recompute(
         * scale
     )  # (block_q, block_k)
     if bias_ref is not None:
-        logits = logits + bias_ref[0].astype(jnp.float32)
+        if bucket_cfg is not None:
+            logits = logits + _bucket_bias_tile(
+                bias_ref, qi, kk,
+                block_q=block_q, block_k=block_k, bucket_cfg=bucket_cfg,
+            )
+        else:
+            logits = logits + bias_ref[0].astype(jnp.float32)
     p = jnp.exp(logits - lse)
     if causal:
         rows = (
@@ -208,6 +278,7 @@ def _bwd_dkv_kernel(
     n_q: int,
     diag_offset: int,
     has_bias: bool = False,
+    bucket_cfg=None,
 ):
     """Grid (b*hq, n_k, n_q): each program owns one K/V block and streams
     Q blocks (innermost, sequential), accumulating dK/dV in VMEM —
@@ -247,7 +318,7 @@ def _bwd_dkv_kernel(
         p, dp, delta = _bwd_recompute(
             q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            qi=qi, kk=kk, diag_offset=diag_offset,
+            qi=qi, kk=kk, diag_offset=diag_offset, bucket_cfg=bucket_cfg,
         )
         # dV += P^T dO
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -282,6 +353,7 @@ def _bwd_dq_kernel(
     n_k: int,
     diag_offset: int,
     has_bias: bool = False,
+    bucket_cfg=None,
 ):
     """Grid (b*hq, n_q, n_k): each program owns one Q block and streams
     K/V blocks — Q-stationary half, same schedule as the forward.
@@ -308,7 +380,7 @@ def _bwd_dq_kernel(
         p, dp, delta = _bwd_recompute(
             q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            qi=qi, kk=kk, diag_offset=diag_offset,
+            qi=qi, kk=kk, diag_offset=diag_offset, bucket_cfg=bucket_cfg,
         )
         ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
@@ -375,9 +447,139 @@ def _bwd_dbias_kernel(
         db_ref[0] = db_acc[:].astype(db_ref.dtype)
 
 
+def _bwd_dtable_kernel(
+    q_ref,
+    do_ref,
+    o_ref,
+    lse_ref,
+    k_ref,
+    v_ref,
+    table_ref,
+    dt_ref,
+    dt_acc,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_q: int,
+    n_k: int,
+    n_b: int,
+    diag_offset: int,
+    bucket_cfg,
+):
+    """Bucket-table gradient: grid (hq, n_q, n_k, B) with every non-head
+    dimension inner, so one (1, buckets) output tile per head is revisited
+    across all (q-block, k-block, batch) steps and the whole reduction
+    ``dtable[b] = sum over positions in bucket b of dS/scale`` happens in
+    VMEM.  The bucket ids are recomputed per tile exactly as the forward
+    did (``_bucket_bias_tile``'s math), so gradient routing can't drift
+    from the bias it differentiates."""
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
+    bb = pl.program_id(3)
+    buckets, max_dist, bidirectional = bucket_cfg
+
+    @pl.when((qi == 0) & (kk == 0) & (bb == 0))
+    def _init():
+        dt_acc[:] = jnp.zeros_like(dt_acc)
+
+    if causal:
+        any_visible = kk * block_k <= (
+            qi * block_q + block_q - 1 + diag_offset
+        )
+    else:
+        any_visible = jnp.ones((), bool)
+
+    @pl.when(any_visible)
+    def _compute():
+        p, dp, delta = _bwd_recompute(
+            q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, table_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            qi=qi, kk=kk, diag_offset=diag_offset, bucket_cfg=bucket_cfg,
+        )
+        ds = p * (dp - delta)  # logit-space grad; bias enters unscaled
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, ds.shape, 0
+        )
+        cols = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, ds.shape, 1
+        )
+        bucket = rel_pos_bucket(
+            cols - rows,
+            bidirectional=bidirectional,
+            buckets=buckets,
+            max_dist=max_dist,
+        )
+        for b in range(buckets):  # static, small
+            dt_acc[0, b] = dt_acc[0, b] + jnp.sum(
+                jnp.where(bucket == b, ds, 0.0)
+            )
+
+    @pl.when((qi == n_q - 1) & (kk == n_k - 1) & (bb == n_b - 1))
+    def _emit():
+        dt_ref[0, :] = dt_acc[0, :].astype(dt_ref.dtype)
+
+
+def _flash_dtable(
+    qh, doh, oh, lse_b, kh, vh, table, *,
+    b, hq, hkv, causal, scale, block_q, block_k, interpret, bucket_cfg,
+):
+    """The dtable pallas call (see ``_bwd_dtable_kernel``)."""
+    _, sq, d = qh.shape
+    skv = kh.shape[1]
+    n_rep = hq // hkv
+    block_q = _shrink_block(block_q, sq)
+    block_k = _shrink_block(block_k, skv)
+    n_q, n_k = sq // block_q, skv // block_k
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    buckets = bucket_cfg[0]
+
+    q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda h, qi, kk, bb: (bb * hq + h, qi, 0)
+    )
+    res_spec = pl.BlockSpec(
+        (None, block_q, _RES_LANES),
+        lambda h, qi, kk, bb: (bb * hq + h, qi, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d),
+        lambda h, qi, kk, bb: (bb * hkv + h // n_rep, kk, 0),
+    )
+    table_spec = pl.BlockSpec(
+        (1, buckets), lambda h, qi, kk, bb: (h, 0)
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_dtable_kernel,
+            scale=scale_,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            n_q=n_q,
+            n_k=n_k,
+            n_b=b,
+            diag_offset=skv - sq,
+            bucket_cfg=bucket_cfg,
+        ),
+        grid=(hq, n_q, n_k, b),
+        in_specs=[q_spec, q_spec, q_spec, res_spec, kv_spec, kv_spec,
+                  table_spec],
+        out_specs=table_spec,
+        out_shape=jax.ShapeDtypeStruct((hq, buckets), table.dtype),
+        scratch_shapes=[pltpu.VMEM((1, buckets), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "arbitrary", "arbitrary", "arbitrary"
+            ),
+        ),
+        interpret=interpret,
+    )(qh, doh, oh, lse_b, kh, vh, table)
+
+
 def _flash_backward(
     q, k, v, out, lse, g, *, causal, scale, block_q, block_k, interpret,
-    grad_dtype=None, bias=None,
+    grad_dtype=None, bias=None, bucket_cfg=None,
 ):
     """Pallas FlashAttention-2 backward: two kernels — K/V-stationary for
     dK/dV and Q-stationary for dQ — reconstructing probabilities from the
@@ -415,7 +617,7 @@ def _flash_backward(
         block_q=block_q, block_k=block_k, interpret=interpret,
         dq_dtype=dq_dtype,
         part_dtype=jnp.float32 if n_rep > 1 else dkv_dtype,
-        bias=bias,
+        bias=bias, bucket_cfg=bucket_cfg,
     )
 
     dq = jnp.transpose(dq.reshape(b, hq, sq, d), (0, 2, 1, 3))
@@ -431,6 +633,15 @@ def _flash_backward(
     )
     if bias is None:
         return dq, dk, dv
+    if bucket_cfg is not None:
+        dtable = _flash_dtable(
+            qh, doh, oh, lse_b, kh, vh, bias,
+            b=b, hq=hq, hkv=hkv,
+            causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            bucket_cfg=bucket_cfg,
+        )
+        return dq, dk, dv, dtable
     dbias = _flash_dbias(
         qh, doh, oh, lse_b, kh, vh, bias,
         b=b, hq=hq, hkv=hkv,
@@ -460,7 +671,7 @@ def _prepare_flash_bwd(q, g, out, lse):
 def _flash_backward_core(
     qh, doh, oh, lse_b, kh, vh, *,
     b, hq, hkv, causal, scale, block_q, block_k, interpret,
-    dq_dtype, part_dtype, bias=None,
+    dq_dtype, part_dtype, bias=None, bucket_cfg=None,
 ):
     """The two backward pallas calls over head-major operands (see
     ``_flash_backward``).  Returns head-major ``(dq, dk_part, dv_part)``
@@ -493,11 +704,18 @@ def _flash_backward_core(
     ]
     dkv_operands = [qh, doh, oh, lse_b, kh, vh]
     if has_bias:
-        dkv_in_specs.append(
-            pl.BlockSpec(
-                (1, block_q, block_k), lambda c, kk, qi: (c % hq, qi, kk)
+        if bucket_cfg is not None:
+            dkv_in_specs.append(
+                pl.BlockSpec(
+                    (1, bias.shape[1]), lambda c, kk, qi: (c % hq, 0)
+                )
             )
-        )
+        else:
+            dkv_in_specs.append(
+                pl.BlockSpec(
+                    (1, block_q, block_k), lambda c, kk, qi: (c % hq, qi, kk)
+                )
+            )
         dkv_operands.append(bias)
     dkv_out_spec = pl.BlockSpec((1, block_k, d), lambda c, kk, qi: (c, kk, 0))
     dk_part, dv_part = pl.pallas_call(
@@ -510,6 +728,7 @@ def _flash_backward_core(
             n_q=n_q,
             diag_offset=diag_offset,
             has_bias=has_bias,
+            bucket_cfg=bucket_cfg,
         ),
         grid=(b * hq, n_k, n_q),
         in_specs=dkv_in_specs,
@@ -543,11 +762,18 @@ def _flash_backward_core(
     ]
     dq_operands = [qh, doh, oh, lse_b, kh, vh]
     if has_bias:
-        dq_in_specs.append(
-            pl.BlockSpec(
-                (1, block_q, block_k), lambda c, qi, kk: (c % hq, qi, kk)
+        if bucket_cfg is not None:
+            dq_in_specs.append(
+                pl.BlockSpec(
+                    (1, bias.shape[1]), lambda c, qi, kk: (c % hq, 0)
+                )
             )
-        )
+        else:
+            dq_in_specs.append(
+                pl.BlockSpec(
+                    (1, block_q, block_k), lambda c, qi, kk: (c % hq, qi, kk)
+                )
+            )
         dq_operands.append(bias)
     dq = pl.pallas_call(
         functools.partial(
@@ -559,6 +785,7 @@ def _flash_backward_core(
             n_k=n_k,
             diag_offset=diag_offset,
             has_bias=has_bias,
+            bucket_cfg=bucket_cfg,
         ),
         grid=(b * hq, n_q, n_k),
         in_specs=dq_in_specs,
@@ -631,10 +858,10 @@ def _flash_dbias(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
 )
 def _flash_attention_vjp(
-    q, k, v, bias, causal, scale, block_q, block_k, interpret
+    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg
 ):
     return _flash_forward(
         q,
@@ -646,10 +873,13 @@ def _flash_attention_vjp(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        bucket_cfg=bucket_cfg,
     )
 
 
-def _flash_fwd_rule(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_rule(
+    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg
+):
     # pallas backward path (biased or not): save the output + per-row lse
     # instead of recomputing the softmax state chunk by chunk — the saved
     # lse includes the bias, so the backward's p = exp(logits + bias - lse)
@@ -665,6 +895,7 @@ def _flash_fwd_rule(q, k, v, bias, causal, scale, block_q, block_k, interpret):
         block_k=block_k,
         interpret=interpret,
         return_lse=True,
+        bucket_cfg=bucket_cfg,
     )
     return out, (q, k, v, bias, out, lse)
 
@@ -698,13 +929,16 @@ def _attention_chunk(qc, k, v, bias_rows, row_offset, causal, scale):
 _FORCE_CHUNKED_BWD = False
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd_rule(
+    causal, scale, block_q, block_k, interpret, bucket_cfg, res, g
+):
     q, k, v, bias, out, lse = res
-    if _FORCE_CHUNKED_BWD and bias is not None:
+    if _FORCE_CHUNKED_BWD and bias is not None and bucket_cfg is None:
         return _flash_bwd_chunked(q, k, v, bias, g, causal, scale, block_q)
     # pallas FlashAttention-2 backward (see _flash_backward); with bias a
-    # third kernel emits dbias.  _flash_bwd_chunked remains only as the
-    # reference implementation the parity tests compare against.
+    # third kernel emits dbias (or dtable for the in-kernel bucket mode).
+    # _flash_bwd_chunked remains only as the reference implementation the
+    # parity tests compare against.
     grads = _flash_backward(
         q, k, v, out, lse, g,
         causal=causal,
@@ -713,6 +947,7 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
         block_k=block_k,
         interpret=interpret,
         bias=bias,
+        bucket_cfg=bucket_cfg,
     )
     if bias is None:
         dq, dk, dv = grads
@@ -787,6 +1022,10 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    rel_bias_table: Optional[jax.Array] = None,
+    rel_bias_buckets: int = 32,
+    rel_bias_max_dist: int = 128,
+    rel_bias_bidirectional: bool = False,
 ) -> jax.Array:
     """Differentiable entry point: flash kernel forward; the backward is
     the pallas FlashAttention-2 kernel pair (``_flash_backward``) —
@@ -798,11 +1037,30 @@ def flash_attention(
     ``bias``: optional additive logit bias of shape (Hq, Sq, Skv), shared
     across the batch — T5's relative-position bias.  Streamed blockwise
     into the kernel; differentiable (the backward emits dbias).
+
+    ``rel_bias_table``: optional (Hq, buckets) bucket table — the
+    IN-KERNEL bias mode: each tile computes its bias from bucket ids and
+    the per-head table in VMEM, so no (Hq, Sq, Skv) bias ever
+    materializes (T5 long context keeps flash's O(S) memory).
+    Differentiable: the backward emits dtable via a fourth kernel.
+    Requires Sq == Skv; mutually exclusive with ``bias``.
     """
+    if rel_bias_table is not None:
+        if bias is not None:
+            raise ValueError("pass bias OR rel_bias_table, not both")
+        bias = rel_bias_table
+        bucket_cfg = (
+            int(rel_bias_buckets),
+            int(rel_bias_max_dist),
+            bool(rel_bias_bidirectional),
+        )
+    else:
+        bucket_cfg = None
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _flash_attention_vjp(
-        q, k, v, bias, causal, scale, block_q, block_k, interpret
+        q, k, v, bias, causal, scale, block_q, block_k, interpret,
+        bucket_cfg,
     )
 
 
@@ -810,7 +1068,7 @@ def flash_attention(
     jax.jit,
     static_argnames=(
         "causal", "scale", "block_q", "block_k", "interpret",
-        "return_residuals", "return_lse",
+        "return_residuals", "return_lse", "bucket_cfg",
     ),
 )
 def _flash_forward(
@@ -826,8 +1084,16 @@ def _flash_forward(
     interpret: Optional[bool] = None,
     return_residuals: bool = False,
     return_lse: bool = False,
+    bucket_cfg: Optional[tuple] = None,
 ):
     """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
+
+    With ``bucket_cfg = (buckets, max_dist, bidirectional)`` the ``bias``
+    operand is the per-head bucket TABLE of shape (Hq, buckets) instead
+    of a materialized (Hq, Sq, Skv) bias: each kernel tile computes its
+    bias from bucket ids in-VMEM (``_bucket_bias_tile``), so T5-style
+    relative-position attention keeps flash's O(S) memory.  Requires
+    Sq == Skv.
 
     ``block_q``/``block_k`` are upper bounds: each is halved until it
     divides its sequence length, so any length works.  ``interpret``
@@ -882,15 +1148,36 @@ def _flash_forward(
     ]
     operands = [qh, kh, vh]
     if bias is not None:
-        if bias.shape != (hq, sq, skv):
-            raise ValueError(
-                f"bias shape {bias.shape} != (Hq, Sq, Skv) = "
-                f"{(hq, sq, skv)}"
+        if bucket_cfg is not None:
+            if sq != skv:
+                raise ValueError(
+                    "in-kernel bucket bias requires Sq == Skv "
+                    f"(got {sq} vs {skv})"
+                )
+            if bias.shape != (hq, bucket_cfg[0]):
+                raise ValueError(
+                    f"bucket-bias table shape {bias.shape} != "
+                    f"(Hq, buckets) = {(hq, bucket_cfg[0])}"
+                )
+            # the whole per-head table rides into VMEM: (1, buckets)
+            # block, head selected by the index map
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, bias.shape[1]), lambda c, i, kk: (c % hq, 0)
+                )
             )
-        # bias is shared across the batch: program c maps to head c % hq
-        in_specs.append(
-            pl.BlockSpec((1, block_q, block_k), lambda c, i, kk: (c % hq, i, kk))
-        )
+        else:
+            if bias.shape != (hq, sq, skv):
+                raise ValueError(
+                    f"bias shape {bias.shape} != (Hq, Sq, Skv) = "
+                    f"{(hq, sq, skv)}"
+                )
+            # bias is shared across the batch: program c maps to head c % hq
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, block_q, block_k), lambda c, i, kk: (c % hq, i, kk)
+                )
+            )
         operands.append(bias)
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda c, i, kk: (c, i, 0))]
@@ -927,6 +1214,7 @@ def _flash_forward(
             has_bias=bias is not None,
             emit_residuals=return_residuals,
             emit_lse=return_lse,
+            bucket_cfg=bucket_cfg,
         ),
         grid=(b * hq, sq // block_q, n_k),
         in_specs=in_specs,
