@@ -19,7 +19,6 @@ Usage:  python scripts/profile_train_step.py [--logdir /tmp/tdx-trace]
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys

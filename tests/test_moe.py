@@ -8,7 +8,6 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import torchdistx_tpu as tdx
-from torchdistx_tpu import nn
 from torchdistx_tpu.nn import functional_call
 from torchdistx_tpu.nn.moe import MoE, moe_shard_rule
 from torchdistx_tpu.parallel import create_mesh

@@ -25,7 +25,6 @@ from torchdistx_tpu.parallel import (
     ShardedTrainStep,
     Topology,
     collectives,
-    create_mesh,
     gossip_grad_hook,
     hierarchical_mesh,
 )

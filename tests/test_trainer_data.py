@@ -4,7 +4,6 @@ run (the reference's save/reload round-trip pattern at trainer scale)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import torchdistx_tpu as tdx
@@ -12,7 +11,7 @@ from torchdistx_tpu import nn
 from torchdistx_tpu.data import DataLoader, TokenDataset
 from torchdistx_tpu.nn import functional_call
 from torchdistx_tpu.optimizers import anyprecision_adamw
-from torchdistx_tpu.parallel import ShardedTrainStep, create_mesh
+from torchdistx_tpu.parallel import ShardedTrainStep
 from torchdistx_tpu.trainer import Trainer
 
 

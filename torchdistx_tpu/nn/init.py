@@ -9,7 +9,6 @@ the deferred-init call stack, SURVEY §3.2).
 from __future__ import annotations
 
 import math
-import jax
 import jax.numpy as jnp
 
 from .. import ops

@@ -23,7 +23,6 @@ import re
 from typing import Any, Callable, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .fsdp import (
