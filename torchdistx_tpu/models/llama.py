@@ -54,11 +54,24 @@ class LlamaConfig:
     # at 8k+, scripts/bench_flash_attention.py), off elsewhere (the CPU
     # fallback is interpret-mode pallas — exact but slow).
     use_flash: Optional[bool] = None
+    # Sliding-window attention (Mistral/Mixtral scheme): query i attends
+    # keys (i - window, i].  Applies to the single-device flash/jnp paths
+    # and cached decode; not supported together with sp_axis (the ring
+    # would need band-aware hop pruning).
+    sliding_window: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got {self.sp_mode!r}"
+            )
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}"
+            )
+        if self.sliding_window is not None and self.sp_axis is not None:
+            raise ValueError(
+                "sliding_window is not supported together with sp_axis"
             )
         if self.n_kv_heads is None:
             self.n_kv_heads = self.n_heads
@@ -157,9 +170,13 @@ class LlamaAttention(nn.Module):
             from ..ops.flash_attention import flash_attention
 
             # flash_attention reduces block sizes to dividing values itself
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(
+                q, k, v, causal=True, window=cfg.sliding_window
+            )
         else:
-            out = multihead_attention(q, k, v, causal=True)
+            out = multihead_attention(
+                q, k, v, causal=True, window=cfg.sliding_window
+            )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim))
 
     def forward_cached(self, x, rope, cache, cache_pos):
@@ -177,7 +194,8 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, rope, cache_pos)
         k = apply_rope(k, rope, cache_pos)
         out, cache = cached_attention(
-            q, k, v, cache, cache_pos, use_flash=cfg.use_flash
+            q, k, v, cache, cache_pos, use_flash=cfg.use_flash,
+            window=cfg.sliding_window,
         )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 

@@ -49,6 +49,7 @@ def cached_attention(
     scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,
     use_flash: Optional[bool] = None,
+    window: Optional[int] = None,
 ):
     """Incremental attention against a static-shape KV cache — the shared
     decode primitive behind every model's ``forward_cached``.
@@ -72,6 +73,8 @@ def cached_attention(
     at 8k+.  Mid-cache chunked prefill (``cache_pos`` traced or > 0)
     stays on the jnp path.
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     b, s, hq, d = q.shape
     ck, cv = cache
     ck = lax.dynamic_update_slice(
@@ -101,10 +104,13 @@ def cached_attention(
             )
             out = flash_attention(
                 widen(q), widen(k_new), widen(v_new),
-                causal=True, scale=scale,
+                causal=True, scale=scale, window=window,
             )[:, :s]
         else:
-            out = flash_attention(q, k_new, v_new, causal=True, scale=scale)
+            out = flash_attention(
+                q, k_new, v_new, causal=True, scale=scale,
+                window=window,
+            )
         return out, (ck, cv)
     max_seq, hkv = ck.shape[1], ck.shape[2]
     kk = _repeat_kv(ck, hq // hkv)
@@ -116,6 +122,11 @@ def cached_attention(
     visible = (
         jnp.arange(max_seq)[None, :] <= cache_pos + jnp.arange(s)[:, None]
     )
+    if window is not None:
+        visible = visible & (
+            jnp.arange(max_seq)[None, :]
+            > cache_pos + jnp.arange(s)[:, None] - window
+        )
     logits = jnp.where(visible[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
@@ -129,18 +140,32 @@ def multihead_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
-    """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D)."""
+    """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
+
+    ``window``: sliding-window attention (query ``i`` sees keys
+    ``(i - window, i]`` end-aligned), the Mistral/Mixtral scheme;
+    requires ``causal``."""
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if hq != hkv:
         k = _repeat_kv(k, hq // hkv)
         v = _repeat_kv(v, hq // hkv)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # f32 softmax accumulation regardless of input dtype (TPU practice)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        if window is not None:
+            mask = mask & jnp.triu(
+                jnp.ones((sq, skv), bool), k=skv - sq - (window - 1)
+            )
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

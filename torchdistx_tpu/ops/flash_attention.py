@@ -87,6 +87,41 @@ def _bucket_bias_tile(table_ref, qi, ki, *, block_q, block_k, bucket_cfg):
     return bias
 
 
+def _block_visible(qi, kk, *, block_q, block_k, diag_offset, causal, window):
+    """Block-level pruning predicate shared by all kernels: skip K blocks
+    entirely above the causal diagonal AND (with a sliding window)
+    entirely below the attention band ``cols > rows - window``."""
+    vis = jnp.ones((), bool)
+    if causal:
+        vis = vis & (
+            kk * block_k <= qi * block_q + block_q - 1 + diag_offset
+        )
+    if window is not None:
+        vis = vis & (
+            kk * block_k + block_k - 1
+            >= qi * block_q + diag_offset - (window - 1)
+        )
+    return vis
+
+
+def _tile_mask(qi, kk, shape, *, block_q, block_k, diag_offset, causal,
+               window):
+    """(block_q, block_k) bool visibility tile: causal upper mask and the
+    sliding-window lower bound (query i sees keys (i-window, i])."""
+    rows = (
+        qi * block_q
+        + diag_offset
+        + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    )
+    cols = kk * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = jnp.ones(shape, bool)
+    if causal:
+        mask = mask & (cols <= rows)
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    return mask
+
+
 def _shrink_block(block: int, s: int) -> int:
     """Halve ``block`` until it divides ``s`` (upper-bound semantics shared
     by the forward and both backwards — one policy, one place)."""
@@ -111,6 +146,7 @@ def _kernel(
     emit_residuals: bool = False,
     emit_lse: bool = False,
     bucket_cfg=None,
+    window=None,
 ):
     rest = list(rest)
     bias_ref = rest.pop(0) if has_bias else None
@@ -128,14 +164,12 @@ def _kernel(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # block-level causal pruning: if this K block lies entirely above the
-    # diagonal for every row of the Q block, skip its MXU work outright
-    if causal:
-        any_visible = ki * block_k <= (
-            qi * block_q + block_q - 1 + diag_offset
-        )
-    else:
-        any_visible = jnp.ones((), bool)
+    # block-level pruning: skip K blocks fully outside the causal /
+    # sliding-window band for every row of this Q block
+    any_visible = _block_visible(
+        qi, ki, block_q=block_q, block_k=block_k,
+        diag_offset=diag_offset, causal=causal, window=window,
+    )
 
     @pl.when(any_visible)
     def _compute():
@@ -160,16 +194,12 @@ def _kernel(
                 )
             else:
                 logits = logits + bias_ref[0].astype(jnp.float32)
-        if causal:
-            rows = (
-                qi * block_q
-                + diag_offset
-                + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        if causal or window is not None:
+            mask = _tile_mask(
+                qi, ki, logits.shape, block_q=block_q, block_k=block_k,
+                diag_offset=diag_offset, causal=causal, window=window,
             )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, logits.shape, 1
-            )
-            logits = jnp.where(cols <= rows, logits, _NEG_INF)
+            logits = jnp.where(mask, logits, _NEG_INF)
 
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
@@ -212,7 +242,7 @@ def _kernel(
 def _bwd_recompute(
     q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref, *,
     scale, causal, block_q, block_k, qi, kk, diag_offset,
-    bucket_cfg=None,
+    bucket_cfg=None, window=None,
 ):
     """Shared backward-body recompute: reconstitute this tile's
     probabilities from the saved lse and form the dS ingredients.
@@ -246,16 +276,12 @@ def _bwd_recompute(
         else:
             logits = logits + bias_ref[0].astype(jnp.float32)
     p = jnp.exp(logits - lse)
-    if causal:
-        rows = (
-            qi * block_q
-            + diag_offset
-            + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    if causal or window is not None:
+        mask = _tile_mask(
+            qi, kk, p.shape, block_q=block_q, block_k=block_k,
+            diag_offset=diag_offset, causal=causal, window=window,
         )
-        cols = kk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, p.shape, 1
-        )
-        p = jnp.where(cols <= rows, p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -279,6 +305,7 @@ def _bwd_dkv_kernel(
     diag_offset: int,
     has_bias: bool = False,
     bucket_cfg=None,
+    window=None,
 ):
     """Grid (b*hq, n_k, n_q): each program owns one K/V block and streams
     Q blocks (innermost, sequential), accumulating dK/dV in VMEM —
@@ -305,13 +332,10 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        # skip Q blocks whose every row precedes this K block entirely
-        any_visible = kk * block_k <= (
-            qi * block_q + block_q - 1 + diag_offset
-        )
-    else:
-        any_visible = jnp.ones((), bool)
+    any_visible = _block_visible(
+        qi, kk, block_q=block_q, block_k=block_k,
+        diag_offset=diag_offset, causal=causal, window=window,
+    )
 
     @pl.when(any_visible)
     def _compute():
@@ -319,6 +343,7 @@ def _bwd_dkv_kernel(
             q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, kk=kk, diag_offset=diag_offset, bucket_cfg=bucket_cfg,
+            window=window,
         )
         # dV += P^T dO
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -354,6 +379,7 @@ def _bwd_dq_kernel(
     diag_offset: int,
     has_bias: bool = False,
     bucket_cfg=None,
+    window=None,
 ):
     """Grid (b*hq, n_q, n_k): each program owns one Q block and streams
     K/V blocks — Q-stationary half, same schedule as the forward.
@@ -368,12 +394,10 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    if causal:
-        any_visible = kk * block_k <= (
-            qi * block_q + block_q - 1 + diag_offset
-        )
-    else:
-        any_visible = jnp.ones((), bool)
+    any_visible = _block_visible(
+        qi, kk, block_q=block_q, block_k=block_k,
+        diag_offset=diag_offset, causal=causal, window=window,
+    )
 
     @pl.when(any_visible)
     def _compute():
@@ -381,6 +405,7 @@ def _bwd_dq_kernel(
             q_ref, do_ref, o_ref, lse_ref, k_ref, v_ref, bias_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, kk=kk, diag_offset=diag_offset, bucket_cfg=bucket_cfg,
+            window=window,
         )
         ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
@@ -579,7 +604,7 @@ def _flash_dtable(
 
 def _flash_backward(
     q, k, v, out, lse, g, *, causal, scale, block_q, block_k, interpret,
-    grad_dtype=None, bias=None, bucket_cfg=None,
+    grad_dtype=None, bias=None, bucket_cfg=None, window=None,
 ):
     """Pallas FlashAttention-2 backward: two kernels — K/V-stationary for
     dK/dV and Q-stationary for dQ — reconstructing probabilities from the
@@ -617,7 +642,7 @@ def _flash_backward(
         block_q=block_q, block_k=block_k, interpret=interpret,
         dq_dtype=dq_dtype,
         part_dtype=jnp.float32 if n_rep > 1 else dkv_dtype,
-        bias=bias, bucket_cfg=bucket_cfg,
+        bias=bias, bucket_cfg=bucket_cfg, window=window,
     )
 
     dq = jnp.transpose(dq.reshape(b, hq, sq, d), (0, 2, 1, 3))
@@ -671,7 +696,7 @@ def _prepare_flash_bwd(q, g, out, lse):
 def _flash_backward_core(
     qh, doh, oh, lse_b, kh, vh, *,
     b, hq, hkv, causal, scale, block_q, block_k, interpret,
-    dq_dtype, part_dtype, bias=None, bucket_cfg=None,
+    dq_dtype, part_dtype, bias=None, bucket_cfg=None, window=None,
 ):
     """The two backward pallas calls over head-major operands (see
     ``_flash_backward``).  Returns head-major ``(dq, dk_part, dv_part)``
@@ -729,6 +754,7 @@ def _flash_backward_core(
             diag_offset=diag_offset,
             has_bias=has_bias,
             bucket_cfg=bucket_cfg,
+            window=window,
         ),
         grid=(b * hq, n_k, n_q),
         in_specs=dkv_in_specs,
@@ -786,6 +812,7 @@ def _flash_backward_core(
             diag_offset=diag_offset,
             has_bias=has_bias,
             bucket_cfg=bucket_cfg,
+            window=window,
         ),
         grid=(b * hq, n_q, n_k),
         in_specs=dq_in_specs,
@@ -858,10 +885,11 @@ def _flash_dbias(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_attention_vjp(
-    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg
+    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg,
+    window,
 ):
     return _flash_forward(
         q,
@@ -874,11 +902,13 @@ def _flash_attention_vjp(
         block_k=block_k,
         interpret=interpret,
         bucket_cfg=bucket_cfg,
+        window=window,
     )
 
 
 def _flash_fwd_rule(
-    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg
+    q, k, v, bias, causal, scale, block_q, block_k, interpret, bucket_cfg,
+    window,
 ):
     # pallas backward path (biased or not): save the output + per-row lse
     # instead of recomputing the softmax state chunk by chunk — the saved
@@ -896,6 +926,7 @@ def _flash_fwd_rule(
         interpret=interpret,
         return_lse=True,
         bucket_cfg=bucket_cfg,
+        window=window,
     )
     return out, (q, k, v, bias, out, lse)
 
@@ -930,7 +961,7 @@ _FORCE_CHUNKED_BWD = False
 
 
 def _flash_bwd_rule(
-    causal, scale, block_q, block_k, interpret, bucket_cfg, res, g
+    causal, scale, block_q, block_k, interpret, bucket_cfg, window, res, g
 ):
     q, k, v, bias, out, lse = res
     if _FORCE_CHUNKED_BWD and bias is not None and bucket_cfg is None:
@@ -948,6 +979,7 @@ def _flash_bwd_rule(
         interpret=interpret,
         bias=bias,
         bucket_cfg=bucket_cfg,
+        window=window,
     )
     if bias is None:
         dq, dk, dv = grads
@@ -1026,6 +1058,7 @@ def flash_attention(
     rel_bias_buckets: int = 32,
     rel_bias_max_dist: int = 128,
     rel_bias_bidirectional: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Differentiable entry point: flash kernel forward; the backward is
     the pallas FlashAttention-2 kernel pair (``_flash_backward``) —
@@ -1044,7 +1077,23 @@ def flash_attention(
     materializes (T5 long context keeps flash's O(S) memory).
     Differentiable: the backward emits dtable via a fourth kernel.
     Requires Sq == Skv; mutually exclusive with ``bias``.
+
+    ``window``: sliding-window attention (Mistral/Mixtral) — query ``i``
+    attends keys ``(i - window, i]``.  Requires ``causal=True``; blocks
+    outside the band are pruned at the grid level, so compute scales
+    with ``S * window`` instead of ``S^2``.  Mutually exclusive with
+    ``bias``/``rel_bias_table`` (no windowed-bias model family exists to
+    pin the combined semantics against).
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if bias is not None or rel_bias_table is not None:
+            raise ValueError(
+                "window is mutually exclusive with bias/rel_bias_table"
+            )
     if rel_bias_table is not None:
         if bias is not None:
             raise ValueError("pass bias OR rel_bias_table, not both")
@@ -1060,7 +1109,7 @@ def flash_attention(
         interpret = jax.devices()[0].platform != "tpu"
     return _flash_attention_vjp(
         q, k, v, bias, causal, scale, block_q, block_k, interpret,
-        bucket_cfg,
+        bucket_cfg, window,
     )
 
 
@@ -1068,7 +1117,7 @@ def flash_attention(
     jax.jit,
     static_argnames=(
         "causal", "scale", "block_q", "block_k", "interpret",
-        "return_residuals", "return_lse", "bucket_cfg",
+        "return_residuals", "return_lse", "bucket_cfg", "window",
     ),
 )
 def _flash_forward(
@@ -1085,6 +1134,7 @@ def _flash_forward(
     return_residuals: bool = False,
     return_lse: bool = False,
     bucket_cfg: Optional[tuple] = None,
+    window: Optional[int] = None,
 ):
     """(B, Sq, Hq, D) x (B, Skv, Hkv, D)^2 -> (B, Sq, Hq, D).
 
@@ -1215,6 +1265,7 @@ def _flash_forward(
             emit_residuals=return_residuals,
             emit_lse=return_lse,
             bucket_cfg=bucket_cfg,
+            window=window,
         ),
         grid=(b * hq, sq // block_q, n_k),
         in_specs=in_specs,
