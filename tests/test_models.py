@@ -367,3 +367,14 @@ class TestSequenceParallelFamilies:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=3e-4, atol=3e-4
         )
+
+
+def test_mistral_7b_preset():
+    # Mistral-7B = Llama arch + GQA(8 kv heads) + 4096 sliding window;
+    # param count must match the published 7.24B
+    from torchdistx_tpu.models import Llama
+
+    with tdx.fake_mode():
+        m = Llama.from_name("mistral_7b")
+    assert m.num_params() == 7241732096
+    assert m.cfg.sliding_window == 4096 and m.cfg.n_kv_heads == 8

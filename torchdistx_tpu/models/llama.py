@@ -109,6 +109,13 @@ llama_configs = {
         vocab_size=32000, dim=5120, n_layers=40, n_heads=40,
         max_seq_len=4096,
     ),
+    # Mistral-7B: Llama architecture + GQA (8 KV heads) + 4096-token
+    # sliding-window attention (the band the flash kernel block-prunes)
+    "mistral_7b": dict(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+        rope_theta=10000.0, sliding_window=4096,
+    ),
 }
 
 
