@@ -248,3 +248,45 @@ def test_partial_stacked_group_raises_even_nonstrict():
     del sd["model.layers.0.block_sparse_moe.experts.1.w1.weight"]
     with pytest.raises(KeyError, match="partial group"):
         from_torch_state_dict(m, sd, kmap, strict=False)
+
+
+def test_mistral_matches_hf_forward():
+    # Mistral = Llama keys + GQA + sliding window; llama_key_map must
+    # load an HF MistralForCausalLM and match its (windowed) logits
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        sliding_window=8,
+        rms_norm_eps=1e-6,
+        attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+
+    ours = Llama(
+        LlamaConfig(
+            vocab_size=128,
+            dim=32,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            ffn_dim=64,
+            max_seq_len=64,
+            dtype=jnp.float32,
+            norm_eps=1e-6,
+            sliding_window=8,
+            use_flash=False,
+        )
+    )
+    from_torch_state_dict(ours, hf.state_dict(), llama_key_map(2))
+
+    tokens = np.random.RandomState(2).randint(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens)).logits.numpy()
+    our_logits = np.asarray(ours(jnp.asarray(tokens)))
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=1e-3, atol=1e-3)
