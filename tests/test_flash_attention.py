@@ -909,3 +909,40 @@ class TestSlidingWindow:
             multihead_attention(q, q, q, causal=True, window=0)
         with pytest.raises(ValueError, match=">= 1"):
             Llama.from_name("tiny", sliding_window=0)
+
+    def test_windowed_decode_slice_matches_full_band(self):
+        # the O(window) single-token decode slice must equal the full
+        # max_seq band-mask computation at every cache position
+        from torchdistx_tpu.ops.attention import cached_attention
+
+        rs = np.random.RandomState(6)
+        max_seq, w, h, d = 32, 8, 2, 8
+        ck = jnp.asarray(rs.randn(1, max_seq, h, d), jnp.float32)
+        cv = jnp.asarray(rs.randn(1, max_seq, h, d), jnp.float32)
+        for pos in (0, 3, 7, 8, 20, max_seq - 1):
+            q = jnp.asarray(rs.randn(1, 1, h, d), jnp.float32)
+            kn = jnp.asarray(rs.randn(1, 1, h, d), jnp.float32)
+            vn = jnp.asarray(rs.randn(1, 1, h, d), jnp.float32)
+            # traced position (the generate() scan regime)
+            out_w, _ = jax.jit(
+                lambda q, kn, vn, p: cached_attention(
+                    q, kn, vn, (ck, cv), p, use_flash=False, window=w
+                )
+            )(q, kn, vn, jnp.int32(pos))
+            # full-band reference: window >= max_seq disables the slice
+            ck2 = jax.lax.dynamic_update_slice(ck, kn, (0, pos, 0, 0))
+            cv2 = jax.lax.dynamic_update_slice(cv, vn, (0, pos, 0, 0))
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, ck2
+            ).astype(jnp.float32) / np.sqrt(d)
+            j = jnp.arange(max_seq)
+            vis = (j <= pos) & (j > pos - w)
+            logits = jnp.where(vis[None, None, None], logits, -jnp.inf)
+            ref = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                jax.nn.softmax(logits, -1).astype(q.dtype), cv2,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_w), np.asarray(ref), rtol=2e-5, atol=2e-5,
+                err_msg=f"pos={pos}",
+            )

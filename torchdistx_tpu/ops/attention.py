@@ -113,9 +113,36 @@ def cached_attention(
             )
         return out, (ck, cv)
     max_seq, hkv = ck.shape[1], ck.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if (
+        window is not None
+        and bias is None
+        and s == 1
+        and window < max_seq
+    ):
+        # Windowed single-token decode: attend a W-slice of the cache
+        # instead of the full max_seq band — O(window) per generated
+        # token.  The slice ends at the newest token; when fewer than
+        # ``window`` tokens exist yet the leading slots are masked.
+        start = jnp.clip(cache_pos + s - window, 0, max_seq - window)
+        kw = lax.dynamic_slice_in_dim(ck, start, window, axis=1)
+        vw = lax.dynamic_slice_in_dim(cv, start, window, axis=1)
+        kw = _repeat_kv(kw, hq // hkv)
+        vw = _repeat_kv(vw, hq // hkv)
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, kw).astype(jnp.float32) * scale
+        )
+        pos = start + jnp.arange(window)  # global cache slots in the slice
+        # the band's lower edge is enforced by the slice start itself
+        # (start >= cache_pos + 1 - window by construction); only the
+        # not-yet-written upper slots need masking
+        visible = pos[None, :] <= cache_pos
+        logits = jnp.where(visible[None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vw)
+        return out, (ck, cv)
     kk = _repeat_kv(ck, hq // hkv)
     vv = _repeat_kv(cv, hq // hkv)
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
     if bias is not None:
         logits = logits + bias[None].astype(jnp.float32)
