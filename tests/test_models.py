@@ -378,3 +378,13 @@ def test_mistral_7b_preset():
         m = Llama.from_name("mistral_7b")
     assert m.num_params() == 7241732096
     assert m.cfg.sliding_window == 4096 and m.cfg.n_kv_heads == 8
+
+
+def test_llama3_8b_preset():
+    # Llama-3-8B: GQA(8 kv), 128256 vocab, theta 5e5 — published 8.03B
+    from torchdistx_tpu.models import Llama
+
+    with tdx.fake_mode():
+        m = Llama.from_name("llama3_8b")
+    assert m.num_params() == 8030261248
+    assert m.cfg.n_kv_heads == 8 and m.cfg.rope_theta == 500000.0

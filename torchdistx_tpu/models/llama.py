@@ -116,6 +116,12 @@ llama_configs = {
         n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
         rope_theta=10000.0, sliding_window=4096,
     ),
+    # Llama-3-8B: GQA (8 KV heads), 128k vocab, rope theta 5e5
+    "llama3_8b": dict(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+        rope_theta=500000.0,
+    ),
 }
 
 
