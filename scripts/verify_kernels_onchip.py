@@ -1,0 +1,374 @@
+"""On-chip acceptance sweep for every pallas flash-attention configuration.
+
+Purpose: the pytest suite runs the kernels in interpret mode only
+(tests/conftest.py forces the CPU platform), so compiled Mosaic behavior —
+VMEM scratch sizing, output-block revisiting, the bucket-bias select
+chains — is exactly what the suite cannot catch.  This script runs every
+kernel configuration (causal x bias x table x window x GQA x shape class)
+COMPILED on the attached TPU and diffs each against the independent jnp
+reference (`ops.attention.multihead_attention` and local biased variants).
+The suite's interpret-mode parity tests already pin interpret == reference,
+so compiled == reference here closes compiled == interpret transitively.
+
+Outage armor (same pattern as bench.py — a wedged axon relay hangs
+`jax.devices()` forever):
+
+- a ~75 s relay preflight runs first; if it hangs, a degraded-but-parseable
+  record is emitted immediately;
+- cases are grouped into a few phase subprocesses (compile cache amortized
+  within each); each case prints ONE flushed JSON line, and the parent
+  harvests partial stdout even when it must kill a hung phase — so any
+  ~10-minute relay-alive window captures durable per-case evidence;
+- everything runs under a global deadline (TDX_VERIFY_DEADLINE, default
+  1200 s) and the cumulative record is rewritten to KERNEL_ACCEPT.json
+  after every phase.
+
+Case order is by evidentiary value: the flagship causal path first, then
+the round-4 features that have never run compiled (window, bias + dbias,
+bucket table + dtable), then large-shape stress.
+
+Smoke (harness check, interpret mode, no TPU):
+    TDX_VERIFY_PLATFORM=cpu python scripts/verify_kernels_onchip.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_PATH = os.path.join(REPO, "KERNEL_ACCEPT.json")
+if REPO not in sys.path:  # children are launched by script path
+    sys.path.insert(0, REPO)
+
+# (name, phase, spec) — spec drives one fwd+bwd parity check
+CASES = [
+    # --- core: the flagship train/decode paths ---
+    ("causal_mha_1024", "core",
+     dict(b=2, sq=1024, skv=1024, hq=8, hkv=8, d=64, causal=True)),
+    ("causal_gqa_1024", "core",
+     dict(b=2, sq=1024, skv=1024, hq=8, hkv=2, d=64, causal=True)),
+    ("noncausal_512", "core",
+     dict(b=2, sq=512, skv=512, hq=4, hkv=4, d=64, causal=False)),
+    ("decode_cross_256_1024", "core",
+     dict(b=1, sq=256, skv=1024, hq=8, hkv=8, d=64, causal=True)),
+    ("oddlen_384_blockshrink", "core",
+     dict(b=2, sq=384, skv=384, hq=4, hkv=4, d=64, causal=True)),
+    ("causal_f32_512", "core",
+     dict(b=1, sq=512, skv=512, hq=4, hkv=4, d=64, causal=True,
+          dtype="float32")),
+    # --- features: round-4 paths never run compiled ---
+    ("window_256_of_1024", "features",
+     dict(b=2, sq=1024, skv=1024, hq=4, hkv=4, d=64, causal=True,
+          window=256)),
+    ("window_gqa_128", "features",
+     dict(b=1, sq=1024, skv=1024, hq=8, hkv=2, d=64, causal=True,
+          window=128)),
+    ("bias_noncausal_512", "features",
+     dict(b=2, sq=512, skv=512, hq=4, hkv=4, d=64, causal=False,
+          bias=True)),
+    ("bias_causal_512", "features",
+     dict(b=2, sq=512, skv=512, hq=4, hkv=4, d=64, causal=True,
+          bias=True)),
+    ("bucket_table_enc_512", "features",
+     dict(b=2, sq=512, skv=512, hq=4, hkv=4, d=64, causal=False,
+          table=True, bidirectional=True)),
+    ("bucket_table_dec_512", "features",
+     dict(b=2, sq=512, skv=512, hq=4, hkv=4, d=64, causal=True,
+          table=True, bidirectional=False)),
+    # --- stress: multi-block grids at training scale ---
+    ("causal_mha_4096", "stress",
+     dict(b=1, sq=4096, skv=4096, hq=8, hkv=8, d=128, causal=True)),
+    ("window_1024_of_4096", "stress",
+     dict(b=1, sq=4096, skv=4096, hq=8, hkv=2, d=128, causal=True,
+          window=1024)),
+    ("causal_8192_fwd_only", "stress",
+     dict(b=1, sq=8192, skv=8192, hq=4, hkv=4, d=128, causal=True,
+          fwd_only=True)),
+]
+
+PHASES = ["core", "features", "stress"]
+
+
+def _set_platform():
+    p = os.environ.get("TDX_VERIFY_PLATFORM")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
+def _preflight() -> dict:
+    _set_platform()
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    jax.block_until_ready(x @ x)
+    return {"ok": True, "preflight_s": round(time.time() - t0, 2),
+            "device": str(jax.devices()[0])}
+
+
+def _ref_attention(q, k, v, *, causal, bias=None, window=None):
+    """Independent jnp reference: einsum + f32 softmax (+ additive bias).
+
+    Matches `ops.attention.multihead_attention` math; biased variant kept
+    local so this script never depends on the code under test beyond the
+    kernel entry point itself."""
+    import jax
+    import jax.numpy as jnp
+
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias[None].astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        if window is not None:
+            mask = mask & jnp.triu(
+                jnp.ones((sq, skv), bool), k=skv - sq - (window - 1)
+            )
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _max_rel_err(a, b) -> float:
+    import numpy as np
+
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    denom = np.max(np.abs(b)) + 1e-6
+    return float(np.max(np.abs(a - b)) / denom)
+
+
+def _run_case(name: str, spec: dict) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from torchdistx_tpu.ops.flash_attention import (
+        flash_attention,
+        rel_pos_bucket,
+    )
+
+    dtype = jnp.dtype(spec.get("dtype", "bfloat16"))
+    b, sq, skv = spec["b"], spec["sq"], spec["skv"]
+    hq, hkv, d = spec["hq"], spec["hkv"], spec["d"]
+    causal = spec["causal"]
+    window = spec.get("window")
+    buckets, max_dist = 32, 128
+    bidirectional = spec.get("bidirectional", False)
+
+    seed = zlib.crc32(name.encode())  # stable across processes/runs
+    keys = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 6)
+    q = jax.random.normal(keys[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(keys[1], (b, skv, hkv, d), dtype)
+    v = jax.random.normal(keys[2], (b, skv, hkv, d), dtype)
+    w = jax.random.normal(keys[3], (b, sq, hq, d), dtype)  # cotangent probe
+
+    bias = table = None
+    if spec.get("bias"):
+        bias = 0.5 * jax.random.normal(keys[4], (hq, sq, skv), jnp.float32)
+    if spec.get("table"):
+        table = 0.5 * jax.random.normal(keys[4], (hq, buckets), jnp.float32)
+
+    def kernel_fn(q, k, v, bias, table):
+        return flash_attention(
+            q, k, v, causal=causal, bias=bias, window=window,
+            rel_bias_table=table, rel_bias_buckets=buckets,
+            rel_bias_max_dist=max_dist,
+            rel_bias_bidirectional=bidirectional,
+        )
+
+    def ref_fn(q, k, v, bias, table):
+        if table is not None:
+            rel = (
+                jnp.arange(skv)[None, :] - jnp.arange(sq)[:, None]
+            )
+            idx = rel_pos_bucket(
+                rel, bidirectional=bidirectional, buckets=buckets,
+                max_dist=max_dist,
+            )
+            bias = table[:, idx]  # (H, Sq, Skv)
+        return _ref_attention(
+            q, k, v, causal=causal, bias=bias, window=window
+        )
+
+    rec = {"case": name, "spec": spec, "dtype": str(dtype)}
+    t0 = time.time()
+    out_k = jax.block_until_ready(
+        jax.jit(kernel_fn)(q, k, v, bias, table)
+    )
+    rec["fwd_compile_run_s"] = round(time.time() - t0, 2)
+    out_r = jax.block_until_ready(jax.jit(ref_fn)(q, k, v, bias, table))
+    rec["fwd_max_rel_err"] = _max_rel_err(out_k, out_r)
+
+    if not spec.get("fwd_only"):
+        def loss(fn):
+            def f(q, k, v, bias, table):
+                return jnp.sum(
+                    fn(q, k, v, bias, table).astype(jnp.float32)
+                    * w.astype(jnp.float32)
+                )
+            return f
+
+        argnums = [0, 1, 2]
+        grad_names = ["dq", "dk", "dv"]
+        if bias is not None:
+            argnums.append(3)
+            grad_names.append("dbias")
+        if table is not None:
+            argnums.append(4)
+            grad_names.append("dtable")
+        t0 = time.time()
+        gk = jax.block_until_ready(
+            jax.jit(jax.grad(loss(kernel_fn), argnums=tuple(argnums)))(
+                q, k, v, bias, table
+            )
+        )
+        rec["bwd_compile_run_s"] = round(time.time() - t0, 2)
+        gr = jax.block_until_ready(
+            jax.jit(jax.grad(loss(ref_fn), argnums=tuple(argnums)))(
+                q, k, v, bias, table
+            )
+        )
+        for gname, a_, b_ in zip(grad_names, gk, gr):
+            rec[f"{gname}_max_rel_err"] = _max_rel_err(a_, b_)
+
+    # bf16 inputs with f32 kernel accumulation: errors land ~1e-3;
+    # 2e-2 is the alarm threshold, not the expectation
+    tol = 2e-2
+    errs = {k_: v_ for k_, v_ in rec.items() if k_.endswith("_max_rel_err")}
+    rec["ok"] = all(e <= tol for e in errs.values())
+    rec["tol"] = tol
+    return rec
+
+
+def _phase_main(phase: str) -> None:
+    _set_platform()
+    for name, ph, spec in CASES:
+        if ph != phase:
+            continue
+        try:
+            rec = _run_case(name, spec)
+        except Exception as e:  # keep sweeping: one bad case != no record
+            rec = {"case": name, "spec": spec, "ok": False,
+                   "error": f"{type(e).__name__}: {e}"[:500]}
+        print(json.dumps(rec), flush=True)
+
+
+def _harvest(stdout: str) -> list:
+    recs = []
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return recs
+
+
+def _run_phase_subprocess(arg: str, timeout_s: float) -> tuple:
+    """Returns (records, status). Harvests partial output on timeout."""
+    if timeout_s <= 5:
+        return [], "skipped: deadline exhausted"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), arg],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return _harvest(out), (
+            f"killed: hung past {timeout_s:.0f}s (wedged relay?); "
+            "partial records harvested"
+        )
+    recs = _harvest(proc.stdout)
+    if proc.returncode != 0:
+        tail = (proc.stdout[-300:] + proc.stderr[-300:]).strip()
+        return recs, f"rc={proc.returncode}: {tail[-300:]}"
+    return recs, "ok"
+
+
+def _write_record(preflight, phase_status, cases, progress):
+    n_ok = sum(1 for c in cases if c.get("ok"))
+    record = {
+        "metric": "flash_kernel_onchip_acceptance",
+        "progress": progress,
+        "preflight": preflight,
+        "phase_status": phase_status,
+        "cases_total_defined": len(CASES),
+        "cases_run": len(cases),
+        "cases_ok": n_ok,
+        # the sweep's promise is the WHOLE surface: partial runs never
+        # report aggregate acceptance
+        "all_ok": n_ok == len(CASES),
+        "cases": cases,
+    }
+    with open(OUT_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({k: v for k, v in record.items() if k != "cases"}),
+          flush=True)
+
+
+def main() -> None:
+    deadline = time.monotonic() + float(
+        os.environ.get("TDX_VERIFY_DEADLINE", "1200")
+    )
+
+    def left() -> float:
+        return deadline - time.monotonic()
+
+    phase_status: dict = {}
+    cases: list = []
+    _write_record({"skipped": "not reached"}, phase_status, cases, "started")
+
+    pre_recs, pre_status = _run_phase_subprocess(
+        "--preflight", min(75.0, left())
+    )
+    preflight = pre_recs[-1] if pre_recs else {"ok": False,
+                                              "status": pre_status}
+    _write_record(preflight, phase_status, cases, "preflight-done")
+    if not preflight.get("ok"):
+        preflight.setdefault(
+            "note", "relay unresponsive; kernel acceptance not captured"
+        )
+        _write_record(preflight, phase_status, cases, "preflight-failed")
+        return
+
+    for i, phase in enumerate(PHASES):
+        # per-phase budget: split what REMAINS over the remaining phases
+        n_left = len(PHASES) - i
+        budget = max(min(left() / n_left, left() - 10), 120.0)
+        recs, status = _run_phase_subprocess(
+            f"--phase={phase}", min(budget, left())
+        )
+        phase_status[phase] = status
+        cases.extend(recs)
+        _write_record(preflight, phase_status, cases, f"{phase}-done")
+
+    _write_record(preflight, phase_status, cases, "complete")
+
+
+if __name__ == "__main__":
+    if "--preflight" in sys.argv:
+        print(json.dumps(_preflight()), flush=True)
+    elif any(a.startswith("--phase=") for a in sys.argv):
+        _phase_main(next(a.split("=", 1)[1] for a in sys.argv
+                         if a.startswith("--phase=")))
+    else:
+        main()
