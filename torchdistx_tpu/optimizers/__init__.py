@@ -1,4 +1,5 @@
 from .anyprecision_optimizer import AnyPrecisionAdamW, anyprecision_adamw
+from .param_groups import decay_labels, label_tree, with_param_groups
 from .quantized import (
     adam8bit_state_shardings,
     adamw_8bit,
@@ -13,4 +14,7 @@ __all__ = [
     "adam8bit_state_shardings",
     "blockwise_quantize",
     "blockwise_dequantize",
+    "with_param_groups",
+    "decay_labels",
+    "label_tree",
 ]

@@ -160,7 +160,17 @@ def anyprecision_adamw(
 
 class AnyPrecisionAdamW:
     """Stateful wrapper mirroring the reference's optimizer surface:
-    construct with params, call :meth:`step` with grads."""
+    construct with params, call :meth:`step` with grads.
+
+    ``params`` may also be a torch-style **param-group list** —
+    ``[{"params": subtree, "weight_decay": 0.0}, {"params": subtree2}]``
+    — with per-group ``lr`` / ``betas`` / ``eps`` / ``weight_decay``
+    overriding the constructor defaults, matching the reference's
+    ``self.param_groups`` iteration (anyprecision_optimizer.py:75-107).
+    In that mode :meth:`step` takes params/grads as a list of subtrees in
+    the same group order (the initial group params are the template)."""
+
+    _GROUP_KEYS = ("lr", "betas", "eps", "weight_decay")
 
     def __init__(
         self,
@@ -175,17 +185,47 @@ class AnyPrecisionAdamW:
         variance_dtype: Any = jnp.bfloat16,
         compensation_buffer_dtype: Any = jnp.bfloat16,
     ) -> None:
-        self.tx = anyprecision_adamw(
-            lr,
-            betas[0],
-            betas[1],
-            eps,
-            weight_decay,
+        common = dict(
+            learning_rate=lr,
+            b1=betas[0],
+            b2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
             use_kahan_summation=use_kahan_summation,
             momentum_dtype=momentum_dtype,
             variance_dtype=variance_dtype,
             compensation_buffer_dtype=compensation_buffer_dtype,
         )
+        if _is_group_list(params):
+            from .param_groups import with_param_groups
+
+            groups = {}
+            template = []
+            for i, g in enumerate(params):
+                over = dict(g)
+                sub = over.pop("params")
+                bad = set(over) - set(self._GROUP_KEYS)
+                if bad:
+                    raise ValueError(
+                        f"param group {i}: unknown keys {sorted(bad)}; "
+                        f"allowed: {self._GROUP_KEYS}"
+                    )
+                if "betas" in over:
+                    over["b1"], over["b2"] = over.pop("betas")
+                if "lr" in over:
+                    over["learning_rate"] = over.pop("lr")
+                groups[f"g{i}"] = over
+                template.append(sub)
+            params = template
+            labels = [
+                jax.tree_util.tree_map(lambda _, i=i: f"g{i}", sub)
+                for i, sub in enumerate(template)
+            ]
+            self.tx = with_param_groups(
+                anyprecision_adamw, groups, labels, **common
+            )
+        else:
+            self.tx = anyprecision_adamw(**common)
         self.state = self.tx.init(params)
         self._step = jax.jit(
             lambda g, s, p: self.tx.update(g, s, p)
@@ -194,3 +234,13 @@ class AnyPrecisionAdamW:
     def step(self, params: Any, grads: Any) -> Any:
         updates, self.state = self._step(grads, self.state, params)
         return jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+
+
+def _is_group_list(params: Any) -> bool:
+    """Torch-style param-group list: a list/tuple of dicts each carrying
+    a "params" entry (reference anyprecision_optimizer.py:75)."""
+    return (
+        isinstance(params, (list, tuple))
+        and len(params) > 0
+        and all(isinstance(g, dict) and "params" in g for g in params)
+    )

@@ -126,12 +126,32 @@ def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
     psh = jax.tree_util.tree_map(
         lambda p: p.sharding if isinstance(p, jax.Array) else repl, params
     )
+    # per-leaf-path shardings: lets param-slot subtrees WITH HOLES match
+    # (optax.masked / multi_transform moment trees carry MaskedNode where
+    # another group's params sit — structurally != params, but every leaf
+    # they do have is a param slot)
+    ppaths = {
+        jax.tree_util.keystr(path): sh
+        for path, sh in jax.tree_util.tree_flatten_with_path(psh)[0]
+    }
 
     def is_param_like(t: Any) -> bool:
-        return jax.tree_util.tree_structure(t) == pdef
+        if jax.tree_util.tree_structure(t) == pdef:
+            return True
+        leaves = jax.tree_util.tree_flatten_with_path(t)[0]
+        return bool(leaves) and all(
+            jax.tree_util.keystr(p) in ppaths for p, _ in leaves
+        )
+
+    def shard_tree(t: Any) -> Any:
+        if jax.tree_util.tree_structure(t) == pdef:
+            return psh
+        return jax.tree_util.tree_map_with_path(
+            lambda p, _: ppaths[jax.tree_util.keystr(p)], t
+        )
 
     return jax.tree_util.tree_map(
-        lambda t: psh if is_param_like(t) else repl,
+        lambda t: shard_tree(t) if is_param_like(t) else repl,
         state_shape,
         is_leaf=is_param_like,
     )

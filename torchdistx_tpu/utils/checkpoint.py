@@ -132,21 +132,95 @@ def restore_checkpoint(
         out = ckptr.restore(path, restore_args=restore_args)
 
     if like is not None:
-        like_struct = jax.tree_util.tree_structure(like)
-        out_struct = jax.tree_util.tree_structure(out)
-        if like_struct != out_struct:
-            raise ValueError(
-                f"checkpoint structure {out_struct} does not match "
-                f"`like` structure {like_struct}"
-            )
-
-        def conform(l, o):
-            if hasattr(l, "dtype") and o.dtype != l.dtype:
-                return o.astype(l.dtype)
-            return o
-
-        out = jax.tree_util.tree_map(conform, like, out)
+        out = _into_template(like, out, "<root>")
     return out
+
+
+def _into_template(template: Any, restored: Any, path: str) -> Any:
+    """Rebuild ``restored`` (orbax plain nests: NamedTuples as dicts keyed
+    by field, tuples as dicts keyed by index, empty containers as None)
+    into ``template``'s live pytree classes, casting leaves to the
+    template dtypes.
+
+    This is what lets optimizer states round-trip without callers
+    hand-reassembling NamedTuples (optax ``multi_transform`` nests
+    ``PartitionState``/``MaskedState``/``MaskedNode`` three deep — the
+    torch analog is ``load_state_dict`` accepting ``torch.load`` output
+    directly, reference tests/python/test_comm_hooks_fsdp.py:262-331)."""
+    t = template
+    if restored is None:
+        # empty containers (MaskedNode, optax EmptyState, ()) serialize to
+        # None; the template node IS the restored value iff it's leafless
+        if jax.tree_util.tree_leaves(t):
+            raise ValueError(
+                f"checkpoint has no data at {path} but `like` expects "
+                f"leaves there"
+            )
+        return t
+    if isinstance(t, tuple) and hasattr(t, "_fields"):  # NamedTuple
+        if isinstance(restored, dict):
+            missing = set(t._fields) - set(restored)
+            extra = set(restored) - set(t._fields)
+            if missing or extra:
+                raise ValueError(
+                    f"checkpoint/template field mismatch at {path}: "
+                    f"missing {sorted(missing)}, extra {sorted(extra)}"
+                )
+            return type(t)(**{
+                f: _into_template(getattr(t, f), restored[f], f"{path}.{f}")
+                for f in t._fields
+            })
+        if len(restored) != len(t._fields):
+            raise ValueError(
+                f"checkpoint has {len(restored)} entries at {path} but "
+                f"`like` NamedTuple has fields {t._fields}"
+            )
+        return type(t)(*[
+            _into_template(tt, rr, f"{path}.{f}")
+            for f, tt, rr in zip(t._fields, t, restored)
+        ])
+    if isinstance(t, dict):
+        if not isinstance(restored, dict) or set(t) != set(restored):
+            raise ValueError(
+                f"checkpoint structure at {path} ({type(restored).__name__}"
+                f" keys {sorted(restored) if isinstance(restored, dict) else ''})"
+                f" does not match `like` keys {sorted(t)}"
+            )
+        return {
+            k: _into_template(t[k], restored[k], f"{path}[{k!r}]") for k in t
+        }
+    if isinstance(t, (list, tuple)):
+        if isinstance(restored, dict):  # tuples serialize keyed by index
+            expected = {str(i) for i in range(len(t))}
+            if set(restored) != expected:
+                raise ValueError(
+                    f"checkpoint index keys {sorted(restored)} at {path} "
+                    f"do not match `like` sequence of length {len(t)}"
+                )
+            seq = [restored[str(i)] for i in range(len(t))]
+        else:
+            seq = list(restored)
+        if len(seq) != len(t):
+            raise ValueError(
+                f"checkpoint length {len(seq)} != template length "
+                f"{len(t)} at {path}"
+            )
+        return type(t)(
+            _into_template(tt, rr, f"{path}[{i}]")
+            for i, (tt, rr) in enumerate(zip(t, seq))
+        )
+    # template position is a leaf: a container arriving from the
+    # checkpoint is a structure mismatch, not data
+    if isinstance(restored, (dict, list, tuple)):
+        raise ValueError(
+            f"checkpoint has a {type(restored).__name__} at {path} but "
+            f"`like` expects a leaf ({type(t).__name__})"
+        )
+    if hasattr(t, "dtype") and hasattr(restored, "dtype") and (
+        restored.dtype != t.dtype
+    ):
+        return restored.astype(t.dtype)
+    return restored
 
 
 def save_module(path: str, module: Any) -> None:
