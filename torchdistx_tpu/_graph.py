@@ -15,6 +15,7 @@ rationale).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
 from typing import Any, Callable, Optional, Sequence
 
@@ -264,6 +265,10 @@ class RecordingSession:
         # observability: compiles vs dispatches (survive cache clearing)
         self.chunk_compiles = 0
         self.chunk_dispatches = 0
+        # unhashable static-leaf tokens for _eager_compile_sig: id -> a
+        # (monotonic token, held ref) pair (see leaf_sig)
+        self._static_sig_tokens: dict[int, tuple] = {}
+        self._static_sig_counter = itertools.count()
 
     # -- recording ---------------------------------------------------------
 
@@ -520,7 +525,16 @@ class RecordingSession:
             try:
                 return ("static", _freeze(x))
             except TypeError:
-                return ("static-id", id(x))
+                # unhashable static leaf: assign a session-lifetime token
+                # (id() alone could be reused after GC within a session
+                # and collapse two distinct closures' signatures); the
+                # held reference is bounded by the recorded graph's size
+                key = id(x)
+                ent = self._static_sig_tokens.get(key)
+                if ent is None or ent[1] is not x:
+                    ent = (next(self._static_sig_counter), x)
+                    self._static_sig_tokens[key] = ent
+                return ("static-id", ent[0])
 
         is_ph = lambda x: isinstance(x, (NodeRef, GuardedArg))  # noqa: E731
         leaves, _ = jax.tree_util.tree_flatten(

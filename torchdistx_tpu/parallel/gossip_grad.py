@@ -62,7 +62,35 @@ from jax import lax
 from . import collectives
 from .comm_hooks import DefaultState, HookContext
 
-__all__ = ["Topology", "GossipGraDState", "gossip_grad_hook", "INVALID_PEER"]
+__all__ = [
+    "Topology",
+    "GossipGraDState",
+    "gossip_grad_hook",
+    "get_num_modules",
+    "INVALID_PEER",
+]
+
+
+def get_num_modules(module: Any) -> int:
+    """Count the hook-calling units in ``module`` — the analog of the
+    reference's ``get_num_modules`` (gossip_grad.py:319-331), which counts
+    nested FSDP modules because torch fires the comm hook once per wrapped
+    module per backward.
+
+    There is no wrapper class here; the unit a per-submodule hook caller
+    fires for is a submodule that directly OWNS parameters (including the
+    root when it does).  The native ``ShardedTrainStep`` invokes the hook
+    once per step over the whole gradient tree, so its states keep the
+    default ``num_modules=1``; pass
+    ``GossipGraDState(n, num_modules=get_num_modules(m))`` only for
+    trainers that invoke the hook per parameter-owning submodule.
+    Always >= 1 (a parameter-less module still fires one hook call)."""
+    n = sum(
+        1
+        for m in module.modules()
+        if any(p is not None for p in m._parameters.values())
+    )
+    return max(1, n)
 
 INVALID_PEER = -1  # parity: gossip_grad.py:23
 
@@ -186,6 +214,17 @@ class GossipGraDState(DefaultState):
         self.max_branches = max_branches
         keep = max(1, max_branches // self.gossip_period)
         if len(topologies) > keep:
+            import warnings
+
+            warnings.warn(
+                f"GossipGraD: keeping {keep} of {len(topologies)} "
+                f"pre-generated topologies (max_branches={max_branches}, "
+                f"gossip_period={self.gossip_period}) — the schedule "
+                "cycles through fewer distinct shuffles than the "
+                "reference's num_nodes permutations; raise max_branches "
+                "to trade compile time for a longer topology cycle",
+                stacklevel=2,
+            )
             topologies = topologies[:keep]
         self.topologies_set: Sequence[Sequence[int]] = topologies
         self.topology_cycle: Iterator[int] = itertools.cycle(
