@@ -1,7 +1,12 @@
 from . import collectives
 from .comm_hooks import DefaultState, HookContext, allreduce_hook, noop_hook
 from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
-from .gossip_grad import GossipGraDState, Topology, gossip_grad_hook
+from .gossip_grad import (
+    GossipGraDState,
+    Topology,
+    get_num_modules,
+    gossip_grad_hook,
+)
 from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
 from .multihost import init_multihost, is_multihost, process_count, process_index
 from .pp import (
@@ -24,6 +29,7 @@ __all__ = [
     "GossipGraDState",
     "Topology",
     "gossip_grad_hook",
+    "get_num_modules",
     "create_mesh",
     "hierarchical_mesh",
     "mesh_sharding",
