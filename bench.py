@@ -97,6 +97,7 @@ def _train_throughput():
         "flash_attention": True,
         "remat": w["remat"],  # what the workload actually built
         "optimizer": w["optimizer"],
+        "fused_ce": w["fused_ce"],
     }
 
 

@@ -90,9 +90,16 @@ CASES = [
     ("causal_8192_fwd_only", "stress",
      dict(b=1, sq=8192, skv=8192, hq=4, hkv=4, d=128, causal=True,
           fwd_only=True)),
+    # --- fused LM-head cross-entropy (ops/fused_ce.py) ---
+    ("fused_ce_small", "fusedce",
+     dict(kind="fused_ce", n=512, d=256, v=2048)),
+    ("fused_ce_oddvocab", "fusedce",
+     dict(kind="fused_ce", n=384, d=128, v=1000)),
+    ("fused_ce_bench_shape", "fusedce",
+     dict(kind="fused_ce", n=4096, d=2048, v=32000, dtype="bfloat16")),
 ]
 
-PHASES = ["core", "features", "stress"]
+PHASES = ["core", "features", "stress", "fusedce"]
 
 
 def _set_platform():
@@ -151,6 +158,52 @@ def _max_rel_err(a, b) -> float:
     b = np.asarray(b, np.float32)
     denom = np.max(np.abs(b)) + 1e-6
     return float(np.max(np.abs(a - b)) / denom)
+
+
+def _run_fused_ce_case(name: str, spec: dict) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from torchdistx_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    dtype = jnp.dtype(spec.get("dtype", "float32"))
+    n, d, v = spec["n"], spec["d"], spec["v"]
+    seed = zlib.crc32(name.encode())
+    k = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 3)
+    x = jax.random.normal(k[0], (n, d), dtype)
+    w = jax.random.normal(k[1], (v, d), dtype) * 0.1
+    y = jax.random.randint(k[2], (n,), 0, v)
+
+    def ref(x, w):
+        logits = jnp.einsum("nd,vd->nv", x, w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        )
+
+    rec = {"case": name, "spec": spec, "dtype": str(dtype)}
+    t0 = time.time()
+    lf = float(jax.block_until_ready(
+        jax.jit(lambda x, w: fused_linear_cross_entropy(x, w, y))(x, w)
+    ))
+    rec["fwd_compile_run_s"] = round(time.time() - t0, 2)
+    lr = float(jax.jit(ref)(x, w))
+    rec["fwd_max_rel_err"] = abs(lf - lr) / (abs(lr) + 1e-8)
+
+    t0 = time.time()
+    gk = jax.block_until_ready(jax.jit(jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, y), argnums=(0, 1)
+    ))(x, w))
+    rec["bwd_compile_run_s"] = round(time.time() - t0, 2)
+    gr = jax.jit(jax.grad(ref, argnums=(0, 1)))(x, w)
+    for gname, a_, b_ in zip(["dx", "dw"], gk, gr):
+        rec[f"{gname}_max_rel_err"] = _max_rel_err(a_, b_)
+
+    tol = 2e-2
+    errs = {k_: v_ for k_, v_ in rec.items() if k_.endswith("_max_rel_err")}
+    rec["ok"] = all(e <= tol for e in errs.values())
+    rec["tol"] = tol
+    return rec
 
 
 def _run_case(name: str, spec: dict) -> dict:
@@ -261,7 +314,12 @@ def _phase_main(phase: str) -> None:
         if ph != phase:
             continue
         try:
-            rec = _run_case(name, spec)
+            runner = (
+                _run_fused_ce_case
+                if spec.get("kind") == "fused_ce"
+                else _run_case
+            )
+            rec = runner(name, spec)
         except Exception as e:  # keep sweeping: one bad case != no record
             rec = {"case": name, "spec": spec, "ok": False,
                    "error": f"{type(e).__name__}: {e}"[:500]}

@@ -273,7 +273,11 @@ class Llama(nn.Module):
         kw.update(overrides)
         return cls(LlamaConfig(**kw))
 
-    def forward(self, tokens):
+    def forward(self, tokens, return_hidden: bool = False):
+        """``return_hidden=True`` returns the pre-LM-head hidden states —
+        the input losses like ``ops.fused_linear_cross_entropy`` consume
+        together with ``lm_head.weight`` so the (B, S, vocab) logits never
+        materialize."""
         cfg = self.cfg
         x = self.tok_emb(tokens)
         rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -285,6 +289,8 @@ class Llama(nn.Module):
         for blk in self.blocks:
             x = block_fn(blk, x)
         x = self.norm(x)
+        if return_hidden:
+            return x
         return self.lm_head(x)
 
     # -- incremental decoding (KV cache) ----------------------------------

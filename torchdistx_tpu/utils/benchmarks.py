@@ -121,10 +121,27 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
     tokens = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
     labels = jnp.asarray(rs.randint(0, vocab, (batch, seq)), jnp.int32)
 
-    def loss_fn(p):
-        return functional.cross_entropy(
-            functional_call(model, p, (tokens,)), labels
-        )
+    # TDX_BENCH_FUSED_CE=1: route the loss through the fused LM-head CE
+    # kernels (ops/fused_ce.py) — no (B, S, vocab) logits in HBM; the
+    # vocab-fusion A/B from the round-3 profile's ~15 ms/step finding.
+    fused_ce = os.environ.get("TDX_BENCH_FUSED_CE", "0") == "1"
+    if fused_ce:
+        from ..ops.fused_ce import fused_linear_cross_entropy
+
+        def loss_fn(p):
+            h = functional_call(
+                model, p, (tokens,), {"return_hidden": True}
+            )
+            return fused_linear_cross_entropy(
+                h, p["lm_head.weight"], labels
+            )
+
+    else:
+
+        def loss_fn(p):
+            return functional.cross_entropy(
+                functional_call(model, p, (tokens,)), labels
+            )
 
     def step(carry, _):
         p, s = carry
@@ -153,4 +170,5 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
         "flops_per_token": flops_per_token,
         "remat": remat,
         "optimizer": opt_label,
+        "fused_ce": fused_ce,
     }
