@@ -147,7 +147,9 @@ def _preflight() -> dict:
             "device": str(jax.devices()[0])}
 
 
-def _run_phase(arg: str, timeout_s: float) -> dict:
+def _run_phase(
+    arg: str, timeout_s: float, *, script: str = None, env: dict = None
+) -> dict:
     """Run one bench phase in a subprocess; NEVER raise.
 
     The round-2 relay outage taught two failure modes: the backend can
@@ -155,41 +157,65 @@ def _run_phase(arg: str, timeout_s: float) -> dict:
     (``jax.devices()`` never returns).  A phase that fails or times out
     yields a ``{"skipped": ...}`` record instead of aborting the bench, so
     one relay hiccup can never zero a whole round's evidence.
+
+    ``script``/``env`` generalize the same armor to sibling drivers (the
+    kernel-acceptance sweep) — one subprocess contract, one place to fix.
     """
     import subprocess
     import sys
 
+    name = arg or script
     if timeout_s <= 0:
         return {"skipped": "deadline exhausted",
-                "detail": f"no budget left for phase {arg}"}
+                "detail": f"no budget left for phase {name}"}
+    cmd = [sys.executable, script or __file__] + ([arg] if arg else [])
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, arg],
+            cmd,
             capture_output=True,
             text=True,
             timeout=timeout_s,
+            env=env,
         )
     except subprocess.TimeoutExpired:
         return {
             "skipped": "backend unavailable",
-            "detail": f"phase {arg} hung past {timeout_s:.0f}s "
+            "detail": f"phase {name} hung past {timeout_s:.0f}s "
             "(wedged device relay?); subprocess killed",
         }
     if proc.returncode != 0:
         tail = (proc.stdout[-1000:] + proc.stderr[-1000:]).strip()
         if "Unable to initialize backend" in tail or "DEADLINE_EXCEEDED" in tail:
             return {"skipped": "backend unavailable", "detail": tail[-500:]}
-        return {"skipped": f"phase {arg} failed rc={proc.returncode}",
+        return {"skipped": f"phase {name} failed rc={proc.returncode}",
                 "detail": tail[-500:]}
     try:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
-        return {"skipped": f"phase {arg} produced no JSON",
+        return {"skipped": f"phase {name} produced no JSON",
                 "detail": proc.stdout[-500:]}
 
 
+def _run_kernel_sweep(timeout_s: float) -> dict:
+    """Final bench phase: the on-chip kernel acceptance sweep
+    (scripts/verify_kernels_onchip.py).  Piggybacking on the driver's
+    bench run means a relay that is alive at driver time captures
+    compiled-kernel evidence (KERNEL_ACCEPT.json) even when it was
+    wedged for the whole builder session.  Same ``_run_phase`` armor; on
+    a timeout/kill, partial per-case records remain in
+    KERNEL_ACCEPT.json (the sweep rewrites it after every phase)."""
+    if timeout_s <= 80:  # sweep preflight alone needs ~75 s
+        return {"skipped": "deadline exhausted"}
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "verify_kernels_onchip.py")
+    env = dict(os.environ, TDX_VERIFY_DEADLINE=str(int(timeout_s - 5)))
+    if os.environ.get("TDX_BENCH_PLATFORM"):
+        env["TDX_VERIFY_PLATFORM"] = os.environ["TDX_BENCH_PLATFORM"]
+    return _run_phase("", timeout_s, script=script, env=env)
+
+
 def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
-            progress: str) -> str:
+            progress: str, kernels: dict) -> str:
     """Assemble the (always-parseable) bench record from whatever ran."""
     train = dict(train)
     eager_ok = "total_s" in eager
@@ -205,6 +231,7 @@ def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
             "extra": {
                 "progress": progress,
                 "preflight": preflight,
+                "kernel_acceptance": kernels,
                 "deferred_init_s": eager.get("deferred_init_s"),
                 "materialize_s": eager.get("materialize_s"),
                 "params": eager.get("params"),
@@ -235,23 +262,25 @@ def main() -> None:
     def left() -> float:
         return deadline - time.monotonic()
 
-    def emit(train, eager, chunked, preflight, progress):
+    def emit(train, eager, chunked, preflight, progress, kernels):
         # one full parseable record per phase boundary; last line wins
-        print(_record(train, eager, chunked, preflight, progress),
+        print(_record(train, eager, chunked, preflight, progress, kernels),
               flush=True)
 
     pending = {"skipped": "not reached"}
     train, eager, chunked = dict(pending), dict(pending), dict(pending)
+    kernels = dict(pending)
 
     # First record before ANY device contact: even a kill during the very
     # first phase leaves a parseable tail.
-    emit(train, eager, chunked, {"skipped": "not reached"}, "started")
+    emit(train, eager, chunked, {"skipped": "not reached"}, "started",
+         kernels)
 
     # Relay preflight: if a 512x512 matmul can't finish in 75 s the relay
     # is wedged — emit the degraded record immediately rather than letting
     # a driver-side timeout capture nothing.
     preflight = _run_phase("--preflight", min(75.0, left()))
-    emit(train, eager, chunked, preflight, "preflight-done")
+    emit(train, eager, chunked, preflight, "preflight-done", kernels)
     if not preflight.get("ok"):
         preflight.setdefault(
             "note",
@@ -259,22 +288,38 @@ def main() -> None:
             "(last known-good on-chip record: BENCH_r03_local.json)",
         )
         skip = {"skipped": "relay wedged at preflight"}
-        emit(skip, skip, skip, preflight, "preflight-failed")
+        emit(skip, skip, skip, preflight, "preflight-failed", skip)
         return
 
     # Every phase runs in its own process: each nearly fills the 16 GB
     # chip and needs a fresh HBM arena.  Any phase may come back as a
     # {"skipped": ...} record; a record line is emitted after each phase.
-    train = _run_phase("--train-phase", min(700.0, left()))
-    emit(train, eager, chunked, preflight, "train-done")
+    # The kernel-acceptance sweep holds a RESERVE carved out of the
+    # earlier phases' budgets (degrading the chunked A/B first): the
+    # phase caps alone (75+700+400+400) overrun a 1500 s deadline, and
+    # without the reserve a slow-but-alive relay would always starve the
+    # round's compiled-kernel evidence.
+    sweep_reserve = min(350.0, left() * 0.25)
+    train = _run_phase("--train-phase",
+                       min(700.0, left() - sweep_reserve - 150))
+    emit(train, eager, chunked, preflight, "train-done", kernels)
 
-    eager = _run_phase("--materialize-phase=eager", min(400.0, left()))
-    emit(train, eager, chunked, preflight, "materialize-eager-done")
+    eager = _run_phase("--materialize-phase=eager",
+                       min(400.0, left() - sweep_reserve - 50))
+    emit(train, eager, chunked, preflight, "materialize-eager-done",
+         kernels)
 
     # A/B: chunked replay batches dispatches (one per compiled chunk) —
     # measured alongside the default so the trade is always on record
-    chunked = _run_phase("--materialize-phase=chunked", min(400.0, left()))
-    emit(train, eager, chunked, preflight, "complete")
+    chunked = _run_phase("--materialize-phase=chunked",
+                         min(400.0, left() - sweep_reserve))
+    emit(train, eager, chunked, preflight, "materialize-chunked-done",
+         kernels)
+
+    # Final phase: compiled-kernel acceptance sweep (full per-case record
+    # lands in KERNEL_ACCEPT.json)
+    kernels = _run_kernel_sweep(min(450.0, left()))
+    emit(train, eager, chunked, preflight, "complete", kernels)
 
 
 if __name__ == "__main__":

@@ -25,7 +25,11 @@ from torchdistx_tpu import nn
 from torchdistx_tpu.data import DataLoader, TokenDataset
 from torchdistx_tpu.models import GPT2
 from torchdistx_tpu.nn import functional_call
-from torchdistx_tpu.optimizers import anyprecision_adamw
+from torchdistx_tpu.optimizers import (
+    anyprecision_adamw,
+    decay_labels,
+    with_param_groups,
+)
 from torchdistx_tpu.parallel import ShardedTrainStep, create_mesh, fsdp_shard_rule
 from torchdistx_tpu.trainer import Trainer
 
@@ -45,9 +49,22 @@ def main() -> None:
         logits = functional_call(model, params, (tokens,))
         return nn.functional.cross_entropy(logits, labels)
 
+    # the standard torch two-group recipe (weight decay on matrices only),
+    # expressed as labeled leaves: decay_labels routes biases/norm scales
+    # to the no_decay group, everything else decays
+    optimizer = with_param_groups(
+        anyprecision_adamw,
+        groups={
+            "decay": {"weight_decay": 0.01},
+            "no_decay": {"weight_decay": 0.0},
+        },
+        labels=decay_labels,
+        learning_rate=3e-4,
+        use_kahan_summation=True,
+    )
     step = ShardedTrainStep(
         loss_fn,
-        anyprecision_adamw(3e-4, weight_decay=0.01, use_kahan_summation=True),
+        optimizer,
         mesh,
         shard_axis="fsdp",
     )

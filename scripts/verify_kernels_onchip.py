@@ -352,8 +352,8 @@ def _run_phase_subprocess(arg: str, timeout_s: float) -> tuple:
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
         return _harvest(out), (
-            f"killed: hung past {timeout_s:.0f}s (wedged relay?); "
-            "partial records harvested"
+            f"killed: exceeded its {timeout_s:.0f}s budget (slow cases or "
+            "a wedged relay); partial records harvested"
         )
     recs = _harvest(proc.stdout)
     if proc.returncode != 0:

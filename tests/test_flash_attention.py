@@ -471,6 +471,7 @@ class TestUlysses:
         )
 
     @pytest.mark.parametrize("hq,hkv", [(8, 8), (16, 8)])
+    @pytest.mark.slow
     def test_gradients_match_full_attention(self, hq, hkv):
         from torchdistx_tpu.parallel import create_mesh
 
@@ -731,6 +732,7 @@ class TestBucketBias:
                 err_msg=name,
             )
 
+    @pytest.mark.slow
     def test_t5_flash_bucket_bias_parity(self):
         from torchdistx_tpu.models import T5
         from torchdistx_tpu.nn import functional, functional_call
@@ -845,6 +847,7 @@ class TestSlidingWindow:
             np.asarray(out), np.asarray(self._ref(q, q, q, 6)), atol=2e-6
         )
 
+    @pytest.mark.slow
     def test_llama_sliding_window_generate_matches_forward(self):
         # windowed decode through the KV cache must equal the windowed
         # full forward's next-token choices

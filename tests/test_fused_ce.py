@@ -96,3 +96,36 @@ def test_shape_validation():
         fused_linear_cross_entropy(x, w.T, y)
     with pytest.raises(ValueError, match="labels"):
         fused_linear_cross_entropy(x, w, y[:-1])
+
+
+@pytest.mark.parametrize("family", ["gpt2", "t5"])
+def test_model_hidden_path_matches_logits(family):
+    # return_hidden + fused CE == cross_entropy(model logits) for the
+    # tied-head families (GPT-2 plain tie, T5 scaled tie)
+    import torchdistx_tpu as tdx
+
+    tdx.manual_seed(0)
+    if family == "gpt2":
+        from torchdistx_tpu.models import GPT2
+
+        m = tdx.deferred_init(GPT2.from_name, "tiny")
+        tdx.materialize_module(m)
+        p = dict(m.named_parameters())
+        toks = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+        args = (toks,)
+        w_key = "tok_emb.weight"
+    else:
+        from torchdistx_tpu.models import T5
+
+        m = tdx.deferred_init(T5.from_name, "tiny")
+        tdx.materialize_module(m)
+        p = dict(m.named_parameters())
+        toks = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+        args = (toks, toks)
+        w_key = "shared_emb.weight"
+    from torchdistx_tpu.nn import functional_call
+
+    h = functional_call(m, p, args, {"return_hidden": True})
+    fused = fused_linear_cross_entropy(h, p[w_key], toks)
+    ref = functional.cross_entropy(functional_call(m, p, args), toks)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-4)

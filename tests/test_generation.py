@@ -32,6 +32,7 @@ class TestCachedForward:
             np.asarray(cached), np.asarray(plain), rtol=2e-5, atol=2e-5
         )
 
+    @pytest.mark.slow
     def test_incremental_matches_prefill(self):
         m = _model()
         rs = np.random.RandomState(1)
@@ -51,6 +52,7 @@ class TestCachedForward:
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_full_recompute(self):
         m = _model()
         prompt = jnp.asarray(
@@ -183,6 +185,7 @@ class TestT5GenerateEncDec:
         tdx.materialize_module(m)
         return m
 
+    @pytest.mark.slow
     def test_greedy_matches_full_recompute(self):
         from torchdistx_tpu.generation import generate_encdec
 

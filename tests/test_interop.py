@@ -25,6 +25,7 @@ from torchdistx_tpu.models.llama import LlamaConfig  # noqa: E402
 from torchdistx_tpu.models.t5 import T5Config  # noqa: E402
 
 
+@pytest.mark.slow
 def test_gpt2_matches_hf_forward():
     hf_cfg = transformers.GPT2Config(
         vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=4

@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import torchdistx_tpu as tdx
@@ -119,6 +120,7 @@ def test_ep_sharded_train_step_matches_unsharded():
         )
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_full_recompute():
     tdx.manual_seed(16)
     m = Mixtral.from_name("tiny")

@@ -206,6 +206,7 @@ class TestT5SequenceParallel:
     cross-attention rings over the encoder's key shards."""
 
     @pytest.mark.parametrize("use_flash", [False, True])
+    @pytest.mark.slow
     def test_sp_forward_matches_unsharded(self, use_flash):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -243,6 +244,7 @@ class TestT5SequenceParallel:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
         )
 
+    @pytest.mark.slow
     def test_sp_gradients_match_unsharded(self):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -324,6 +326,7 @@ class TestSequenceParallelFamilies:
         )(params, *args)
 
     @pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+    @pytest.mark.slow
     def test_gpt2_sp_matches_unsharded(self, sp_mode):
         from torchdistx_tpu.models import GPT2
         from torchdistx_tpu.parallel import create_mesh
@@ -347,6 +350,7 @@ class TestSequenceParallelFamilies:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
         )
 
+    @pytest.mark.slow
     def test_mixtral_sp_matches_unsharded(self):
         from torchdistx_tpu.models import Mixtral
         from torchdistx_tpu.parallel import create_mesh

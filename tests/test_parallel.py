@@ -275,6 +275,7 @@ class TestGossipGraD:
         specs256, _ = big.branch_table()
         assert len(specs256) <= big.max_branches
 
+    @pytest.mark.slow
     def test_max_branches_capped_schedule_executes(self):
         # A capped schedule must still run end-to-end: 8 nodes with a
         # 6-branch budget keeps 2 of 8 shuffles (period 3) and the hook

@@ -382,6 +382,7 @@ class TestLlamaPipeline:
             use_flash=False,
         )
 
+    @pytest.mark.slow
     def test_llama_blocks_deferred_init_pp_matches_unpipelined(self):
         from torchdistx_tpu.models.llama import pp_stage
 

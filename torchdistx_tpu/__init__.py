@@ -11,7 +11,7 @@ Flagship features (reference README.md:15-18):
   - AnyPrecisionAdamW (:mod:`torchdistx_tpu.optimizers`)
 """
 
-__version__ = "0.4.0.dev0"
+__version__ = "0.5.0.dev0"
 
 from . import nn, ops
 from .generation import generate

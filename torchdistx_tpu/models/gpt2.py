@@ -140,7 +140,11 @@ class GPT2(nn.Module):
         kw.update(overrides)
         return cls(GPT2Config(**kw))
 
-    def forward(self, tokens):
+    def forward(self, tokens, return_hidden: bool = False):
+        """``return_hidden=True`` returns the post-ln_f hidden states for
+        ``ops.fused_linear_cross_entropy`` (with the tied
+        ``tok_emb.weight`` as the head) — no (B, S, vocab) logits in
+        HBM."""
         s = tokens.shape[1]
         if self.cfg.sp_axis is not None:
             import jax
@@ -165,6 +169,8 @@ class GPT2(nn.Module):
         for blk in self.blocks:
             x = blk(x)
         x = self.ln_f(x)
+        if return_hidden:
+            return x
         # weight-tied head (GPT-2 ties lm_head to tok_emb)
         return x @ self.tok_emb.weight.T
 

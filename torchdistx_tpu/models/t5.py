@@ -288,7 +288,11 @@ class T5(nn.Module):
                 bias = b  # first layer's rel bias shared by the stack
         return self.enc_norm(x)
 
-    def forward(self, enc_tokens, dec_tokens):
+    def forward(self, enc_tokens, dec_tokens, return_hidden: bool = False):
+        """``return_hidden=True`` returns the decoder hidden states with
+        T5's 1/sqrt(dim) head scaling already applied, so
+        ``ops.fused_linear_cross_entropy(h, shared_emb.weight, labels)``
+        reproduces the tied-head logits without materializing them."""
         enc = self.encode(enc_tokens)
         x = self.shared_emb(dec_tokens)
         bias = None
@@ -298,7 +302,10 @@ class T5(nn.Module):
                 bias = b
         x = self.dec_norm(x)
         # tied output head with T5's 1/sqrt(dim) scaling
-        return (x * (self.cfg.dim**-0.5)) @ self.shared_emb.weight.T
+        x = x * (self.cfg.dim**-0.5)
+        if return_hidden:
+            return x
+        return x @ self.shared_emb.weight.T
 
     # -- incremental encoder-decoder decode (generation.generate_encdec) --
 

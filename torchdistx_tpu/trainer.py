@@ -98,11 +98,13 @@ class Trainer:
             "opt_state": self.opt_state,
             "global_step": 0,
         }
-        out = restore_checkpoint(path, shardings_from=template)
+        # like= rebuilds the optimizer NamedTuples around the
+        # already-placed leaves (orbax returns plain nests)
+        out = restore_checkpoint(
+            path, like=template, shardings_from=template
+        )
         self.params = out["params"]
-        # optimizer states are NamedTuples; orbax returns plain nests —
-        # rebuild the classes around the already-placed leaves
-        self.opt_state = _from_tree(self.opt_state, out["opt_state"])
+        self.opt_state = out["opt_state"]
         self.global_step = int(out["global_step"])
 
     # -- loop --------------------------------------------------------------
@@ -214,68 +216,3 @@ class Trainer:
             "step": self.global_step,
             "loss": float(loss) if loss is not None else float("nan"),
         }
-
-
-def _from_tree(template: Any, restored: Any) -> Any:
-    """Rebuild ``template``'s pytree classes (optimizer NamedTuples) from a
-    plain nested-container restore.
-
-    orbax restores NamedTuples as dicts keyed by field name, so the rebuild
-    matches by NAME, never by leaf order (dict iteration is sorted, which
-    would silently permute same-shaped optimizer slots like exp_avg /
-    exp_avg_sq).
-    """
-    if template is None:
-        return None
-    if restored is None and not jax.tree_util.tree_leaves(template):
-        # empty containers (optax EmptyState, disabled Kahan tuples)
-        # serialize to None; keep the template's empty structure
-        return template
-    if isinstance(template, tuple) and hasattr(template, "_fields"):
-        if isinstance(restored, dict):
-            missing = [f for f in template._fields if f not in restored]
-            # empty-container fields (e.g. a disabled Kahan buffer tuple)
-            # legitimately vanish in serialization
-            missing = [
-                f
-                for f in missing
-                if jax.tree_util.tree_leaves(getattr(template, f))
-            ]
-            if missing:
-                raise KeyError(
-                    f"restored optimizer state is missing fields {missing} "
-                    f"of {type(template).__name__}"
-                )
-            return type(template)(
-                **{
-                    f: _from_tree(getattr(template, f), restored.get(f))
-                    for f in template._fields
-                }
-            )
-        if len(restored) != len(template):
-            raise ValueError(
-                f"restored state has {len(restored)} entries, template "
-                f"{type(template).__name__} has {len(template)}"
-            )
-        return type(template)(
-            *(_from_tree(t, r) for t, r in zip(template, restored))
-        )
-    if isinstance(template, dict):
-        return {k: _from_tree(v, restored[k]) for k, v in template.items()}
-    if isinstance(template, (list, tuple)):
-        if restored is None and len(template) == 0:
-            return template
-        restored_seq = (
-            list(restored.values())
-            if isinstance(restored, dict)
-            else list(restored)
-        )
-        if len(restored_seq) != len(template):
-            raise ValueError(
-                f"restored state has {len(restored_seq)} entries, template "
-                f"has {len(template)}"
-            )
-        return type(template)(
-            _from_tree(t, r) for t, r in zip(template, restored_seq)
-        )
-    return restored

@@ -159,7 +159,14 @@ def _into_template(template: Any, restored: Any, path: str) -> Any:
         return t
     if isinstance(t, tuple) and hasattr(t, "_fields"):  # NamedTuple
         if isinstance(restored, dict):
-            missing = set(t._fields) - set(restored)
+            # a missing field whose template value is leafless (disabled
+            # Kahan tuple, optax EmptyState) legitimately vanished in
+            # serialization; a missing field WITH leaves is data loss
+            missing = {
+                f
+                for f in set(t._fields) - set(restored)
+                if jax.tree_util.tree_leaves(getattr(t, f))
+            }
             extra = set(restored) - set(t._fields)
             if missing or extra:
                 raise ValueError(
@@ -167,7 +174,9 @@ def _into_template(template: Any, restored: Any, path: str) -> Any:
                     f"missing {sorted(missing)}, extra {sorted(extra)}"
                 )
             return type(t)(**{
-                f: _into_template(getattr(t, f), restored[f], f"{path}.{f}")
+                f: _into_template(
+                    getattr(t, f), restored.get(f), f"{path}.{f}"
+                )
                 for f in t._fields
             })
         if len(restored) != len(t._fields):
