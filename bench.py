@@ -96,6 +96,7 @@ def _train_throughput():
         "mfu": round(mfu, 4),
         "flash_attention": True,
         "remat": w["remat"],  # what the workload actually built
+        "remat_policy": w["remat_policy"],
         "optimizer": w["optimizer"],
         "fused_ce": w["fused_ce"],
     }

@@ -392,3 +392,91 @@ def test_llama3_8b_preset():
         m = Llama.from_name("llama3_8b")
     assert m.num_params() == 8030261248
     assert m.cfg.n_kv_heads == 8 and m.cfg.rope_theta == 500000.0
+
+
+class TestRematPolicy:
+    def test_grads_identical_across_policies(self):
+        # remat changes WHAT is saved, never the math: loss and grads must
+        # match bitwise-closely across off/full/dots
+        import torchdistx_tpu as tdx
+        from torchdistx_tpu.models import Llama
+        from torchdistx_tpu.nn import functional, functional_call
+
+        results = {}
+        for policy, remat in [(None, False), ("full", True), ("dots", True)]:
+            tdx.manual_seed(0)
+            kw = dict(max_seq_len=32, remat=remat, use_flash=False)
+            if policy:
+                kw["remat_policy"] = policy
+            m = tdx.deferred_init(Llama.from_name, "tiny", **kw)
+            tdx.materialize_module(m)
+            p = dict(m.named_parameters())
+            toks = jnp.asarray(
+                np.random.RandomState(0).randint(0, 64, (2, 32)), jnp.int32
+            )
+
+            def loss(p):
+                return functional.cross_entropy(
+                    functional_call(m, p, (toks,)), toks
+                )
+
+            l, g = jax.value_and_grad(loss)(p)
+            results[policy or "off"] = (float(l), g)
+
+        l0, g0 = results["off"]
+        for k in ("full", "dots"):
+            l1, g1 = results[k]
+            np.testing.assert_allclose(l1, l0, rtol=1e-6)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0)
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    atol=1e-5,
+                )
+
+    def test_unknown_policy_rejected_at_construction(self):
+        from torchdistx_tpu.models import Llama
+
+        with pytest.raises(ValueError, match="remat_policy"):
+            Llama.from_name("tiny", remat_policy="typo")
+
+    def test_mixtral_honors_policy(self):
+        # the MoE training path threads the same policy (and the same
+        # grads-invariance) as the inherited Llama paths
+        import torchdistx_tpu as tdx
+        from torchdistx_tpu.models import Mixtral
+        from torchdistx_tpu.nn import functional, functional_call
+
+        results = {}
+        for policy in ("full", "dots"):
+            tdx.manual_seed(0)
+            m = tdx.deferred_init(
+                Mixtral.from_name, "tiny", remat=True, remat_policy=policy,
+                use_flash=False,
+            )
+            tdx.materialize_module(m)
+            p = dict(m.named_parameters())
+            toks = jnp.asarray(
+                np.random.RandomState(0).randint(0, 64, (2, 16)), jnp.int32
+            )
+
+            def loss(p):
+                logits, aux = functional_call(
+                    m, p, (toks,), method="forward_with_aux"
+                )
+                return functional.cross_entropy(logits, toks) + 0.01 * aux
+
+            l, g = jax.value_and_grad(loss)(p)
+            results[policy] = (float(l), g)
+        np.testing.assert_allclose(
+            results["dots"][0], results["full"][0], rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(results["dots"][1]),
+            jax.tree_util.tree_leaves(results["full"][1]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=1e-5,
+            )

@@ -44,6 +44,15 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block
+    # Rematerialization policy when remat=True (the memory/FLOPs dial):
+    #   "full"  — recompute everything (jax.checkpoint default); smallest
+    #             footprint, costs ~23% of the bench step (BASELINE.md)
+    #   "dots"  — jax.checkpoint_policies.dots_with_no_batch_dims_saveable:
+    #             matmul outputs are SAVED, only elementwise/softmax work
+    #             recomputes — recovers most of full-remat's overhead
+    #             (recompute becomes VPU work overlapped with the MXU)
+    #             while still dropping the attention-probs working set
+    remat_policy: str = "full"
     sp_axis: Optional[str] = None  # sequence parallelism over this mesh axis
     # "ring" (K/V rotate, works for any head count, O(S)-bias support) or
     # "ulysses" (two all-to-alls around local attention; needs head counts
@@ -65,6 +74,13 @@ class LlamaConfig:
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got {self.sp_mode!r}"
             )
+        if self.remat_policy not in ("full", "dots"):
+            # validated at construction like sp_mode (not lazily at the
+            # first rematted forward, far from the typo)
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {self.remat_policy!r}"
+            )
         if self.sliding_window is not None and self.sliding_window < 1:
             raise ValueError(
                 f"sliding_window must be >= 1, got {self.sliding_window}"
@@ -83,6 +99,18 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+
+def _remat_policy(name: str):
+    """Resolve ``LlamaConfig.remat_policy`` to a jax.checkpoint policy
+    (None = recompute everything, the jax.checkpoint default)."""
+    if name == "full":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat_policy must be 'full' or 'dots', got {name!r}"
+    )
 
 
 def _hf_normal(shape, dtype):
@@ -282,7 +310,11 @@ class Llama(nn.Module):
         x = self.tok_emb(tokens)
         rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
         block_fn = (
-            jax.checkpoint(lambda blk, h: blk(h, rope), static_argnums=(0,))
+            jax.checkpoint(
+                lambda blk, h: blk(h, rope),
+                static_argnums=(0,),
+                policy=_remat_policy(cfg.remat_policy),
+            )
             if cfg.remat
             else (lambda blk, h: blk(h, rope))
         )

@@ -103,7 +103,12 @@ class Mixtral(Llama):
         rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
         block_fn = lambda blk, h: blk(h, rope, return_aux=True)  # noqa: E731
         if cfg.remat:
-            block_fn = jax.checkpoint(block_fn, static_argnums=(0,))
+            from .llama import _remat_policy
+
+            block_fn = jax.checkpoint(
+                block_fn, static_argnums=(0,),
+                policy=_remat_policy(cfg.remat_policy),
+            )
         aux_total = jnp.zeros((), jnp.float32)
         for blk in self.blocks:
             x, aux = block_fn(blk, x)

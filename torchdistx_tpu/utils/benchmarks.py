@@ -92,10 +92,20 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
     # 15.6k / 0.52 rematted (the recompute is ~23% of step time).  Set
     # TDX_BENCH_REMAT=1 for configs whose activations don't fit (batch>=4).
     remat = os.environ.get("TDX_BENCH_REMAT", "0") == "1"
+    # TDX_BENCH_REMAT_POLICY=dots: save matmul outputs, recompute only
+    # elementwise work — the A/B against full-block recompute (~23% of
+    # the step, BASELINE.md) for shapes that need remat at all
+    remat_policy = os.environ.get("TDX_BENCH_REMAT_POLICY", "full")
+    if remat_policy != "full" and not remat:
+        raise ValueError(
+            "TDX_BENCH_REMAT_POLICY has no effect without TDX_BENCH_REMAT=1"
+            " — refusing to run an A/B leg that silently never remats"
+        )
 
     tdx.manual_seed(0)
     model = tdx.deferred_init(
-        Llama.from_name, name, max_seq_len=seq, remat=remat
+        Llama.from_name, name, max_seq_len=seq, remat=remat,
+        remat_policy=remat_policy,
     )
     tdx.materialize_module(model)
     params = dict(model.named_parameters())
@@ -169,6 +179,7 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
         "seq": seq,
         "flops_per_token": flops_per_token,
         "remat": remat,
+        "remat_policy": remat_policy,
         "optimizer": opt_label,
         "fused_ce": fused_ce,
     }
