@@ -216,7 +216,7 @@ def _run_kernel_sweep(timeout_s: float) -> dict:
 
 
 def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
-            progress: str, kernels: dict) -> str:
+            progress: str, kernels: dict, train_fused: dict) -> str:
     """Assemble the (always-parseable) bench record from whatever ran."""
     train = dict(train)
     eager_ok = "total_s" in eager
@@ -233,6 +233,14 @@ def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
                 "progress": progress,
                 "preflight": preflight,
                 "kernel_acceptance": kernels,
+                # fused-CE A/B leg, trimmed to its verdict fields
+                "train_fused_ce": {
+                    k: train_fused[k]
+                    for k in ("tokens_per_sec", "mfu", "train_final_loss",
+                              "train_warm_converged", "fused_ce",
+                              "train_model", "skipped", "detail")
+                    if k in train_fused
+                },
                 "deferred_init_s": eager.get("deferred_init_s"),
                 "materialize_s": eager.get("materialize_s"),
                 "params": eager.get("params"),
@@ -263,14 +271,16 @@ def main() -> None:
     def left() -> float:
         return deadline - time.monotonic()
 
-    def emit(train, eager, chunked, preflight, progress, kernels):
-        # one full parseable record per phase boundary; last line wins
-        print(_record(train, eager, chunked, preflight, progress, kernels),
-              flush=True)
-
     pending = {"skipped": "not reached"}
     train, eager, chunked = dict(pending), dict(pending), dict(pending)
     kernels = dict(pending)
+
+    def emit(train, eager, chunked, preflight, progress, kernels,
+             train_fused=None):
+        # one full parseable record per phase boundary; last line wins
+        print(_record(train, eager, chunked, preflight, progress, kernels,
+                      train_fused if train_fused is not None else pending),
+              flush=True)
 
     # First record before ANY device contact: even a kill during the very
     # first phase leaves a parseable tail.
@@ -297,7 +307,8 @@ def main() -> None:
     # {"skipped": ...} record; a record line is emitted after each phase.
     # The kernel-acceptance sweep holds a RESERVE carved out of the
     # earlier phases' budgets (degrading the chunked A/B first): the
-    # phase caps alone (75+700+400+400) overrun a 1500 s deadline, and
+    # phase caps alone (75+700+400+400+450+450 incl. the sweep and the
+    # fused-CE A/B) far overrun a 1500 s deadline, and
     # without the reserve a slow-but-alive relay would always starve the
     # round's compiled-kernel evidence.
     sweep_reserve = min(350.0, left() * 0.25)
@@ -317,10 +328,24 @@ def main() -> None:
     emit(train, eager, chunked, preflight, "materialize-chunked-done",
          kernels)
 
-    # Final phase: compiled-kernel acceptance sweep (full per-case record
-    # lands in KERNEL_ACCEPT.json)
-    kernels = _run_kernel_sweep(min(450.0, left()))
-    emit(train, eager, chunked, preflight, "complete", kernels)
+    # Compiled-kernel acceptance sweep (full per-case record lands in
+    # KERNEL_ACCEPT.json).  Runs BEFORE the fused-CE A/B leg: under a
+    # slow-but-alive relay the sweep's long-context acceptance evidence
+    # outranks a second throughput number.
+    kernels = _run_kernel_sweep(min(450.0, left() - 100))
+    emit(train, eager, chunked, preflight, "kernel-sweep-done", kernels)
+
+    # Fused-CE train A/B: the same train phase with the fused LM-head
+    # loss (ops/fused_ce.py) — captured at driver time so the round-5
+    # vocab-bandwidth lever gets an on-chip number whenever the relay is
+    # alive for the bench at all.
+    train_fused = _run_phase(
+        "--train-phase",
+        min(450.0, left()),
+        env=dict(os.environ, TDX_BENCH_FUSED_CE="1"),
+    )
+    emit(train, eager, chunked, preflight, "complete", kernels,
+         train_fused)
 
 
 if __name__ == "__main__":
