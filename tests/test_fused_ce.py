@@ -129,3 +129,34 @@ def test_model_hidden_path_matches_logits(family):
     fused = fused_linear_cross_entropy(h, p[w_key], toks)
     ref = functional.cross_entropy(functional_call(m, p, args), toks)
     np.testing.assert_allclose(float(fused), float(ref), rtol=1e-4)
+
+
+def test_sequence_parallel_shard_map(mesh8):
+    # per-shard fused CE + pmean == global CE (equal shard sizes), in
+    # value and in grads — the loss SP training composes with
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n, d, v = 512, 64, 256
+    x, w, y = _mk(n, d, v, jnp.float32, seed=4)
+
+    def local_loss(x, w, y):
+        return jax.lax.pmean(fused_linear_cross_entropy(x, w, y), "fsdp")
+
+    def sm(f):
+        return shard_map(
+            f, mesh=mesh8, in_specs=(P("fsdp"), P(), P("fsdp")),
+            out_specs=P(), check_vma=False,
+        )
+
+    loss_sp = jax.jit(sm(local_loss))(x, w, y)
+    np.testing.assert_allclose(float(loss_sp), float(_ref(x, w, y)),
+                               rtol=1e-6)
+    g_sp = jax.jit(jax.grad(
+        lambda x, w: sm(local_loss)(x, w, y), argnums=(0, 1)
+    ))(x, w)
+    g_ref = jax.grad(
+        lambda x, w: _ref(x, w, y), argnums=(0, 1)
+    )(x, w)
+    for a, b in zip(g_sp, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
