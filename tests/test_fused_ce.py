@@ -160,3 +160,34 @@ def test_sequence_parallel_shard_map(mesh8):
     )(x, w)
     for a, b in zip(g_sp, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_gpt2_vocab_padding():
+    # 50257 has no good block divisor (7*43*167): _blocks must pad the
+    # vocab instead of shrinking block_v to 1 (a 50k-step grid), and the
+    # padded columns must vanish from the loss and both gradients
+    from torchdistx_tpu.ops.fused_ce import _blocks
+
+    bt, bv, n_t, n_v, v_pad = _blocks(64, 50257, 256, 512)
+    assert bv == 512 and v_pad == 50688 and n_v == 99
+
+    n, d, v = 64, 32, 50257
+    x, w, _ = _mk(n, d, v, jnp.float32, seed=6)
+    y = jnp.concatenate([
+        jnp.asarray([0, 50256, 50255]),  # last true columns
+        jax.random.randint(jax.random.PRNGKey(7), (n - 3,), 0, v),
+    ])
+    loss_f = fused_linear_cross_entropy(x, w, y)
+    np.testing.assert_allclose(float(loss_f), float(_ref(x, w, y)),
+                               rtol=1e-5)
+    gx_f, gw_f = jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, y), argnums=(0, 1)
+    )(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: _ref(x, w, y), argnums=(0, 1)
+    )(x, w)
+    assert gw_f.shape == (v, d)  # sliced back to the true vocab
+    for a, b in ((gx_f, gx_r), (gw_f, gw_r)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        )

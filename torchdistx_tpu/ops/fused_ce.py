@@ -49,9 +49,26 @@ from .flash_attention import _RES_LANES, _shrink_block
 __all__ = ["fused_linear_cross_entropy"]
 
 
+def _logits_tile(x_ref, w_ref, vi, *, block_v: int, v_true: int):
+    """(block_t, block_v) f32 logits tile, with columns beyond the TRUE
+    vocab (zero-padded W rows — see ``_blocks``) masked to -inf so they
+    vanish from the softmax and from every gradient."""
+    x = x_ref[...].astype(jnp.float32)  # (block_t, D)
+    w = w_ref[...].astype(jnp.float32)  # (block_v, D)
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (block_t, block_v)
+    cols = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1
+    )
+    if v_true % block_v != 0:  # only the padded case pays the select
+        logits = jnp.where(cols < v_true, logits, -1e30)
+    return logits, cols, x, w
+
+
 def _fwd_kernel(
     x_ref, w_ref, lab_ref, loss_ref, lse_ref, m_ref, l_ref, zy_ref,
-    *, block_t: int, block_v: int, n_v: int,
+    *, block_t: int, block_v: int, n_v: int, v_true: int,
 ):
     vi = pl.program_id(1)
 
@@ -61,11 +78,9 @@ def _fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         zy_ref[:] = jnp.zeros_like(zy_ref)
 
-    x = x_ref[...].astype(jnp.float32)  # (block_t, D)
-    w = w_ref[...].astype(jnp.float32)  # (block_v, D)
-    logits = jax.lax.dot_general(
-        x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (block_t, block_v)
+    logits, cols, _, _ = _logits_tile(
+        x_ref, w_ref, vi, block_v=block_v, v_true=v_true
+    )
 
     m_prev = m_ref[:]
     m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
@@ -77,9 +92,6 @@ def _fwd_kernel(
     # label logit: the (single) column of this tile matching the token's
     # label contributes; every token's label lands in exactly one tile
     labels = lab_ref[...][:, :1]  # (block_t, 1) int32
-    cols = vi * block_v + jax.lax.broadcasted_iota(
-        jnp.int32, logits.shape, 1
-    )
     zy_ref[:] = zy_ref[:] + jnp.sum(
         jnp.where(cols == labels, logits, 0.0), axis=-1, keepdims=True
     )
@@ -93,7 +105,7 @@ def _fwd_kernel(
 
 def _dx_kernel(
     x_ref, w_ref, lab_ref, lse_ref, dx_ref, dx_acc,
-    *, block_t: int, block_v: int, n_v: int, inv_n: float,
+    *, block_t: int, block_v: int, n_v: int, inv_n: float, v_true: int,
 ):
     vi = pl.program_id(1)
 
@@ -101,15 +113,12 @@ def _dx_kernel(
     def _init():
         dx_acc[:] = jnp.zeros_like(dx_acc)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
-    logits = jax.lax.dot_general(
-        x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    logits, cols, _, w = _logits_tile(
+        x_ref, w_ref, vi, block_v=block_v, v_true=v_true
     )
     lse = lse_ref[...][:, :1]
-    p = jnp.exp(logits - lse)
+    p = jnp.exp(logits - lse)  # exactly 0 at padded columns
     labels = lab_ref[...][:, :1]
-    cols = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
     dp = (p - jnp.where(cols == labels, 1.0, 0.0)) * inv_n
     dx_acc[:] = dx_acc[:] + jax.lax.dot_general(
         dp, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -122,7 +131,7 @@ def _dx_kernel(
 
 def _dw_kernel(
     x_ref, w_ref, lab_ref, lse_ref, dw_ref, dw_acc,
-    *, block_t: int, block_v: int, n_t: int, inv_n: float,
+    *, block_t: int, block_v: int, n_t: int, inv_n: float, v_true: int,
 ):
     vi = pl.program_id(0)
     ti = pl.program_id(1)
@@ -131,15 +140,12 @@ def _dw_kernel(
     def _init():
         dw_acc[:] = jnp.zeros_like(dw_acc)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
-    logits = jax.lax.dot_general(
-        x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    logits, cols, x, _ = _logits_tile(
+        x_ref, w_ref, vi, block_v=block_v, v_true=v_true
     )
     lse = lse_ref[...][:, :1]
-    p = jnp.exp(logits - lse)
+    p = jnp.exp(logits - lse)  # exactly 0 at padded columns
     labels = lab_ref[...][:, :1]
-    cols = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
     dp = (p - jnp.where(cols == labels, 1.0, 0.0)) * inv_n
     # dW_tile += dP^T @ X : (block_v, D)
     dw_acc[:] = dw_acc[:] + jax.lax.dot_general(
@@ -152,9 +158,20 @@ def _dw_kernel(
 
 
 def _blocks(n: int, v: int, block_t: int, block_v: int):
+    """Token/vocab tiling.  Vocab sizes with no good divisor (GPT-2's
+    50257 = 7*43*167 would shrink block_v to 1 — a 50k-step grid) are
+    PADDED up to a block multiple instead; the kernels mask the padded
+    columns to -inf (``_logits_tile``), so they vanish from the softmax
+    and every gradient, and the wrapper slices dW back to the true rows.
+    Returns (bt, bv, n_t, n_v, v_pad)."""
     bt = _shrink_block(block_t, n)
     bv = _shrink_block(block_v, v)
-    return bt, bv, n // bt, v // bv
+    if bv < 128 and v > 128:
+        bv = block_v  # honor the caller's tile bound; pad V up to it
+        v_pad = -(-v // bv) * bv
+    else:
+        v_pad = v
+    return bt, bv, n // bt, v_pad // bv, v_pad
 
 
 def _broadcast_lanes(a):
@@ -170,12 +187,14 @@ def _fused_ce(x, w, labels, block_t, block_v, interpret):
 def _fused_ce_fwd_impl(x, w, labels, block_t, block_v, interpret):
     n, d = x.shape
     v = w.shape[0]
-    bt, bv, n_t, n_v = _blocks(n, v, block_t, block_v)
+    bt, bv, n_t, n_v, v_pad = _blocks(n, v, block_t, block_v)
+    if v_pad != v:
+        w = jnp.pad(w, ((0, v_pad - v), (0, 0)))
     lab_b = _broadcast_lanes(labels.astype(jnp.int32))
     res_spec = pl.BlockSpec((bt, _RES_LANES), lambda ti, vi: (ti, 0))
     loss_rows, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, block_t=bt, block_v=bv, n_v=n_v
+            _fwd_kernel, block_t=bt, block_v=bv, n_v=n_v, v_true=v
         ),
         grid=(n_t, n_v),
         in_specs=[
@@ -210,14 +229,17 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
     x, w, labels, lse = res
     n, d = x.shape
     v = w.shape[0]
-    bt, bv, n_t, n_v = _blocks(n, v, block_t, block_v)
+    bt, bv, n_t, n_v, v_pad = _blocks(n, v, block_t, block_v)
+    if v_pad != v:
+        w = jnp.pad(w, ((0, v_pad - v), (0, 0)))
     inv_n = 1.0 / n
     lab_b = _broadcast_lanes(labels.astype(jnp.int32))
     res_spec_t = pl.BlockSpec((bt, _RES_LANES), lambda ti, vi: (ti, 0))
 
     dx = pl.pallas_call(
         functools.partial(
-            _dx_kernel, block_t=bt, block_v=bv, n_v=n_v, inv_n=inv_n
+            _dx_kernel, block_t=bt, block_v=bv, n_v=n_v, inv_n=inv_n,
+            v_true=v,
         ),
         grid=(n_t, n_v),
         in_specs=[
@@ -238,7 +260,8 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
     res_spec_v = pl.BlockSpec((bt, _RES_LANES), lambda vi, ti: (ti, 0))
     dw = pl.pallas_call(
         functools.partial(
-            _dw_kernel, block_t=bt, block_v=bv, n_t=n_t, inv_n=inv_n
+            _dw_kernel, block_t=bt, block_v=bv, n_t=n_t, inv_n=inv_n,
+            v_true=v,
         ),
         grid=(n_v, n_t),
         in_specs=[
@@ -248,7 +271,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
             res_spec_v,
         ],
         out_specs=pl.BlockSpec((bv, d), lambda vi, ti: (vi, 0)),
-        out_shape=jax.ShapeDtypeStruct((v, d), w.dtype),
+        out_shape=jax.ShapeDtypeStruct((v_pad, d), w.dtype),
         scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
@@ -256,6 +279,8 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
         interpret=interpret,
     )(x, w, lab_b, lse)
 
+    if v_pad != v:
+        dw = dw[:v]  # padded rows carry exact zeros; drop them
     gf = g.astype(jnp.float32)
     return (
         (dx.astype(jnp.float32) * gf).astype(x.dtype),
@@ -287,7 +312,10 @@ def fused_linear_cross_entropy(
     Exactly ``nn.functional.cross_entropy(x @ w.T, labels)`` up to f32
     accumulation order (parity pinned in tests/test_fused_ce.py).
     Differentiable in ``x`` and ``w``.  ``block_t``/``block_v`` are upper
-    bounds shrunk to divide the flattened token count / vocab.
+    bounds shrunk to divide the flattened token count / vocab; a vocab
+    with no divisor >= 128 (GPT-2's 50257) is instead PADDED up to a
+    ``block_v`` multiple, with the padded columns masked in-kernel and
+    dW sliced back to the true rows.
     """
     d = x.shape[-1]
     if w.ndim != 2 or w.shape[1] != d:
