@@ -291,3 +291,27 @@ def test_mistral_matches_hf_forward():
         hf_logits = hf(torch.tensor(tokens)).logits.numpy()
     our_logits = np.asarray(ours(jnp.asarray(tokens)))
     np.testing.assert_allclose(our_logits, hf_logits, rtol=1e-3, atol=1e-3)
+
+
+def test_vit_matches_hf_forward():
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_channels=3, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        num_labels=10,
+    )
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+
+    from torchdistx_tpu.models import ViT, ViTConfig
+    from torchdistx_tpu.interop import vit_key_map
+
+    ours = ViT(ViTConfig(
+        image_size=32, patch_size=8, num_classes=10, dim=32, n_layers=2,
+        n_heads=4, mlp_dim=64, norm_eps=hf_cfg.layer_norm_eps,
+    ))
+    from_torch_state_dict(ours, hf.state_dict(), vit_key_map(2))
+
+    imgs = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(imgs)).logits.numpy()
+    our_logits = np.asarray(ours(jnp.asarray(imgs)))
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)

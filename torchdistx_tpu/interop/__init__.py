@@ -5,6 +5,7 @@ from .torch_interop import (
     mixtral_key_map,
     t5_key_map,
     to_torch_state_dict,
+    vit_key_map,
 )
 
 __all__ = [
@@ -14,4 +15,5 @@ __all__ = [
     "llama_key_map",
     "mixtral_key_map",
     "t5_key_map",
+    "vit_key_map",
 ]

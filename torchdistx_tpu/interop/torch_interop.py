@@ -30,6 +30,7 @@ __all__ = [
     "llama_key_map",
     "mixtral_key_map",
     "t5_key_map",
+    "vit_key_map",
 ]
 
 Transform = Optional[Callable[[np.ndarray], np.ndarray]]
@@ -344,4 +345,51 @@ def t5_key_map(n_layers: int) -> KeyMap:
         "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
         None,
     )
+    return m
+
+
+def vit_key_map(n_layers: int) -> KeyMap:
+    """HF ``ViTForImageClassification`` (``vit.*``) -> our :class:`ViT`.
+
+    Layouts coincide (torch Linear (out, in) == ours; Conv2d
+    (out, in, kh, kw) == ours), so no transforms are needed."""
+    m: KeyMap = {
+        "cls_token": ("vit.embeddings.cls_token", None),
+        "pos_emb": ("vit.embeddings.position_embeddings", None),
+        "patch_embed.weight": (
+            "vit.embeddings.patch_embeddings.projection.weight", None
+        ),
+        "patch_embed.bias": (
+            "vit.embeddings.patch_embeddings.projection.bias", None
+        ),
+        "ln_f.weight": ("vit.layernorm.weight", None),
+        "ln_f.bias": ("vit.layernorm.bias", None),
+        "head.weight": ("classifier.weight", None),
+        "head.bias": ("classifier.bias", None),
+    }
+    for i in range(n_layers):
+        h, b = f"vit.encoder.layer.{i}", f"blocks.{i}"
+        att = f"{h}.attention.attention"
+        m.update(
+            {
+                f"{b}.ln1.weight": (f"{h}.layernorm_before.weight", None),
+                f"{b}.ln1.bias": (f"{h}.layernorm_before.bias", None),
+                f"{b}.q.weight": (f"{att}.query.weight", None),
+                f"{b}.q.bias": (f"{att}.query.bias", None),
+                f"{b}.k.weight": (f"{att}.key.weight", None),
+                f"{b}.k.bias": (f"{att}.key.bias", None),
+                f"{b}.v.weight": (f"{att}.value.weight", None),
+                f"{b}.v.bias": (f"{att}.value.bias", None),
+                f"{b}.proj.weight": (
+                    f"{h}.attention.output.dense.weight", None
+                ),
+                f"{b}.proj.bias": (f"{h}.attention.output.dense.bias", None),
+                f"{b}.ln2.weight": (f"{h}.layernorm_after.weight", None),
+                f"{b}.ln2.bias": (f"{h}.layernorm_after.bias", None),
+                f"{b}.fc1.weight": (f"{h}.intermediate.dense.weight", None),
+                f"{b}.fc1.bias": (f"{h}.intermediate.dense.bias", None),
+                f"{b}.fc2.weight": (f"{h}.output.dense.weight", None),
+                f"{b}.fc2.bias": (f"{h}.output.dense.bias", None),
+            }
+        )
     return m

@@ -3,6 +3,7 @@ from .llama import Llama, LlamaConfig, llama_configs
 from .mixtral import Mixtral, MixtralConfig, mixtral_configs
 from .resnet import ResNet, resnet18, resnet50, resnet101
 from .t5 import T5, T5Config, t5_configs
+from .vit import ViT, ViTConfig, vit_configs
 
 __all__ = [
     "Llama",
@@ -21,4 +22,7 @@ __all__ = [
     "T5",
     "T5Config",
     "t5_configs",
+    "ViT",
+    "ViTConfig",
+    "vit_configs",
 ]

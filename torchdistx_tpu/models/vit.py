@@ -1,0 +1,141 @@
+"""Vision Transformer (ViT) family — encoder-only transformer over image
+patches (Dosovitskiy et al., arXiv:2010.11929).
+
+Rounds out the model-family coverage between ResNet (pure conv) and the
+LM stack (causal decoders / T5 enc-dec): conv patchify stem, a learned
+[CLS] token + positional table, pre-LN bidirectional encoder blocks, and
+a classification head.  Built from the same `nn` layers and the shared
+`multihead_attention`, so deferred init, sharded materialization, fake
+mode, and checkpointing all work unchanged (the reference's API surface
+is model-agnostic; families here exist to prove the framework end to
+end).
+
+TPU notes: attention is non-causal over a fixed 197-token sequence for
+ViT-B/16 at 224px — small enough that the jnp path's fused (S x S)
+softmax is the right choice (flash pays off at 2k+; see
+scripts/bench_flash_attention.py), so there is deliberately no
+`use_flash` knob here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import init
+from ..ops.attention import multihead_attention
+
+__all__ = ["ViT", "ViTConfig", "vit_configs"]
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    norm_eps: float = 1e-6
+    dtype: object = jnp.float32
+
+    def __post_init__(self) -> None:
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+# standard variants (ViT paper table 1); "tiny" for tests
+vit_configs = {
+    "tiny": dict(image_size=32, patch_size=8, num_classes=10, dim=32,
+                 n_layers=2, n_heads=2, mlp_dim=64),
+    "vit_b16": dict(),  # the defaults above
+    "vit_l16": dict(dim=1024, n_layers=24, n_heads=16, mlp_dim=4096),
+}
+
+
+class ViTBlock(nn.Module):
+    # separate q/k/v projections and EXACT (erf) GELU, matching the ViT
+    # paper and HF's ViTForImageClassification layout 1:1 (vit_key_map)
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.q = nn.Linear(cfg.dim, cfg.dim, dtype=cfg.dtype)
+        self.k = nn.Linear(cfg.dim, cfg.dim, dtype=cfg.dtype)
+        self.v = nn.Linear(cfg.dim, cfg.dim, dtype=cfg.dtype)
+        self.proj = nn.Linear(cfg.dim, cfg.dim, dtype=cfg.dtype)
+        self.ln2 = nn.LayerNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.fc1 = nn.Linear(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype)
+        self.fc2 = nn.Linear(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype)
+        self.n_heads = cfg.n_heads
+        self.head_dim = cfg.dim // cfg.n_heads
+
+    def forward(self, x):
+        b, s, d = x.shape
+        h = self.ln1(x)
+        shape = (b, s, self.n_heads, self.head_dim)
+        q = self.q(h).reshape(shape)
+        k = self.k(h).reshape(shape)
+        v = self.v(h).reshape(shape)
+        att = multihead_attention(q, k, v, causal=False)
+        x = x + self.proj(att.reshape(b, s, d))
+        x = x + self.fc2(F.gelu(self.fc1(self.ln2(x)), approximate=False))
+        return x
+
+
+class ViT(nn.Module):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.patch_embed = nn.Conv2d(
+            3, cfg.dim, cfg.patch_size, stride=cfg.patch_size,
+            dtype=cfg.dtype,
+        )
+        # [CLS] token + learned positions over (1 + n_patches) slots
+        self.cls_token = nn.Parameter(
+            init.truncated_normal((1, 1, cfg.dim), std=0.02,
+                                  dtype=cfg.dtype)
+        )
+        self.pos_emb = nn.Parameter(
+            init.truncated_normal((1, 1 + cfg.n_patches, cfg.dim),
+                                  std=0.02, dtype=cfg.dtype)
+        )
+        self.blocks = nn.ModuleList(
+            [ViTBlock(cfg) for _ in range(cfg.n_layers)]
+        )
+        self.ln_f = nn.LayerNorm(cfg.dim, eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.head = nn.Linear(cfg.dim, cfg.num_classes, dtype=cfg.dtype)
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "ViT":
+        kw = dict(vit_configs[name])
+        kw.update(overrides)
+        return cls(ViTConfig(**kw))
+
+    def forward(self, images, return_hidden: bool = False):
+        """``images``: (B, 3, H, W).  Returns (B, num_classes) logits, or
+        the (B, 1 + n_patches, dim) encoded sequence with
+        ``return_hidden=True`` (feature extraction / linear probing)."""
+        b = images.shape[0]
+        x = self.patch_embed(images)  # (B, dim, H/p, W/p)
+        x = x.reshape(b, self.cfg.dim, -1).transpose(0, 2, 1)
+        cls = jnp.broadcast_to(
+            self.cls_token, (b, 1, self.cfg.dim)
+        ).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + self.pos_emb
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if return_hidden:
+            return x
+        return self.head(x[:, 0])
