@@ -1,0 +1,169 @@
+"""The whole on-chip evidence queue as ONE serial command.
+
+Runs, in priority order and strictly serially (CLAUDE.md: never two TPU
+processes), every measurement the round needs from a relay-alive window:
+
+1. ``bench.py``             — headline record (train MFU, 7B materialize,
+                              kernel-acceptance sweep, fused-CE A/B)
+2. ``bench_flash_attention``— corrected long-context fwd+bwd rows
+                              (the round-3 32k/64k rows were invalidated
+                              by gradient DCE; the harness now consumes
+                              every gradient)
+3. ``bench_fused_ce``       — kernel-level fused-vs-unfused loss A/B
+4. ``bench.py --train-phase`` with TDX_BENCH_OPT=8bit      — optimizer A/B
+5. ``bench.py --train-phase`` with REMAT=1 x {full, dots}  — remat A/B
+6. ``bench_generate``       — int8 decode A/B
+7. ``bench_t5_train``       — biased-kernel train delta
+
+Each step is a subprocess under its own slice of a global deadline
+(``TDX_CAMPAIGN_DEADLINE``, default 5400 s); stdout JSON lines are
+harvested (even from killed steps) into ``CAMPAIGN.json`` after every
+step, so a window that closes mid-run still leaves everything captured
+so far.  A wedged relay costs one bench preflight (~75 s) and produces a
+degraded-but-parseable record.
+
+Usage:  python scripts/onchip_campaign.py
+Smoke:  TDX_CAMPAIGN_PLATFORM=cpu TDX_CAMPAIGN_DEADLINE=600 \
+            python scripts/onchip_campaign.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_PATH = os.path.join(REPO, "CAMPAIGN.json")
+
+
+def _steps() -> list:
+    py = sys.executable
+    bench = os.path.join(REPO, "bench.py")
+    sdir = os.path.join(REPO, "scripts")
+    smoke = os.environ.get("TDX_CAMPAIGN_PLATFORM") == "cpu"
+    # (name, cmd, extra_env, budget_s).  bench_full's budget sits ABOVE
+    # bench.py's internal 1500 s deadline so its graceful final record
+    # emit never races the subprocess kill.
+    return [
+        ("bench_full", [py, bench], {}, 1600),
+        ("flash_long_context",
+         [py, os.path.join(sdir, "bench_flash_attention.py")]
+         + (["--seqs", "256"] if smoke else
+            ["--seqs", "8192,32768,65536"]),
+         {}, 900),
+        ("fused_ce_kernel_ab",
+         [py, os.path.join(sdir, "bench_fused_ce.py")]
+         + (["--cpu", "--shapes", "256x128x512", "--iters", "2"]
+            if smoke else []),
+         {}, 600),
+        ("train_8bit_opt", [py, bench, "--train-phase"],
+         {"TDX_BENCH_OPT": "8bit"}, 400),
+        ("train_remat_full", [py, bench, "--train-phase"],
+         {"TDX_BENCH_REMAT": "1"}, 400),
+        ("train_remat_dots", [py, bench, "--train-phase"],
+         {"TDX_BENCH_REMAT": "1", "TDX_BENCH_REMAT_POLICY": "dots"}, 400),
+        ("generate_bf16", [py, os.path.join(sdir, "bench_generate.py")],
+         {}, 400),
+        ("generate_int8",
+         [py, os.path.join(sdir, "bench_generate.py"), "--quantize"],
+         {}, 400),
+        ("t5_biased_kernels", [py, os.path.join(sdir, "bench_t5_train.py")],
+         {}, 500),
+    ]
+
+
+def _harvest(out: str) -> list:
+    recs = []
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return recs
+
+
+def main() -> None:
+    deadline = time.monotonic() + float(
+        os.environ.get("TDX_CAMPAIGN_DEADLINE", "5400")
+    )
+    platform_env = {}
+    if os.environ.get("TDX_CAMPAIGN_PLATFORM"):
+        p = os.environ["TDX_CAMPAIGN_PLATFORM"]
+        # bench.py maps TDX_BENCH_PLATFORM into its chained sweep itself
+        platform_env = {"TDX_BENCH_PLATFORM": p}
+        if p == "cpu":  # tiny shapes for the harness smoke
+            platform_env.update(
+                TDX_BENCH_MODEL="tiny", TDX_BENCH_TRAIN_MODEL="tiny",
+                TDX_BENCH_SEQ="64", TDX_BENCH_DEADLINE="300",
+                TDX_GEN_MODEL="tiny", TDX_T5_MODEL="tiny",
+            )
+
+    results: dict = {}
+
+    def write(status: str) -> None:
+        with open(OUT_PATH, "w") as f:
+            json.dump({"status": status, "steps": results}, f, indent=1)
+        print(json.dumps({"campaign": status,
+                          "done": list(results)}), flush=True)
+
+    def relay_wedged(recs: list) -> bool:
+        # bench.py's record carries the preflight verdict; a failed
+        # preflight means every further TPU step would hang to its full
+        # budget for nothing (the docstring's ~75 s promise)
+        for r in reversed(recs):
+            pre = r.get("extra", {}).get("preflight")
+            if isinstance(pre, dict):
+                return not pre.get("ok", False)
+        return False
+
+    write("started")
+    wedged = False
+    for name, cmd, extra, budget in _steps():
+        left = deadline - time.monotonic()
+        if wedged:
+            results[name] = {"skipped": "relay wedged at bench preflight"}
+            continue
+        if left <= 30:
+            results[name] = {"skipped": "campaign deadline exhausted"}
+            continue
+        env = dict(os.environ, **platform_env, **extra)
+        t0 = time.time()
+        err = ""
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=min(budget, left), env=env, cwd=REPO,
+            )
+            out, rc = proc.stdout, proc.returncode
+            err = proc.stderr or ""
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            err = e.stderr or ""
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            rc = "timeout"
+        recs = _harvest(out)
+        results[name] = {
+            "rc": rc,
+            "wall_s": round(time.time() - t0, 1),
+            "records": recs[-8:],  # the tail is the signal
+        }
+        if rc != 0 or not recs:
+            # evidence for the post-mortem after the window closes
+            results[name]["stderr_tail"] = err[-2000:]
+        if name == "bench_full" and relay_wedged(recs):
+            wedged = True
+        write("running")
+    skipped = [n for n, v in results.items() if "skipped" in v]
+    write("wedged" if wedged else ("partial" if skipped else "complete"))
+
+
+if __name__ == "__main__":
+    main()
