@@ -168,8 +168,8 @@ def test_gpt2_vocab_padding():
     # padded columns must vanish from the loss and both gradients
     from torchdistx_tpu.ops.fused_ce import _blocks
 
-    bt, bv, n_t, n_v, v_pad = _blocks(64, 50257, 256, 512)
-    assert bv == 512 and v_pad == 50688 and n_v == 99
+    bt, bv, n_t, n_v, v_pad, n_pad = _blocks(64, 50257, 256, 512)
+    assert bv == 512 and v_pad == 50688 and n_v == 99 and n_pad == 64
 
     n, d, v = 64, 32, 50257
     x, w, _ = _mk(n, d, v, jnp.float32, seed=6)
@@ -191,3 +191,28 @@ def test_gpt2_vocab_padding():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5
         )
+
+
+def test_prime_token_count_padding():
+    # 509 tokens (prime) would shrink block_t to 1; the token dim pads
+    # instead, with padded rows masked out of the loss mean and both
+    # gradients
+    from torchdistx_tpu.ops.fused_ce import _blocks
+
+    bt, bv, n_t, n_v, v_pad, n_pad = _blocks(509, 512, 256, 512)
+    assert bt == 256 and n_pad == 512 and n_t == 2
+
+    n, d, v = 509, 32, 512
+    x, w, y = _mk(n, d, v, jnp.float32, seed=8)
+    loss_f = fused_linear_cross_entropy(x, w, y)
+    np.testing.assert_allclose(float(loss_f), float(_ref(x, w, y)),
+                               rtol=1e-5)
+    gx_f, gw_f = jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, y), argnums=(0, 1)
+    )(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: _ref(x, w, y), argnums=(0, 1)
+    )(x, w)
+    assert gx_f.shape == (n, d)
+    for a, b in ((gx_f, gx_r), (gw_f, gw_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -103,10 +103,24 @@ def _fwd_kernel(
         lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
+def _row_mask(dp, ti, *, block_t: int, n_true: int):
+    """Zero dp rows beyond the TRUE token count (zero-padded X rows —
+    see ``_blocks``); their softmax rows are garbage and must not leak
+    into dX/dW."""
+    if n_true % block_t == 0:
+        return dp
+    rows = ti * block_t + jax.lax.broadcasted_iota(
+        jnp.int32, dp.shape, 0
+    )
+    return jnp.where(rows < n_true, dp, 0.0)
+
+
 def _dx_kernel(
     x_ref, w_ref, lab_ref, lse_ref, dx_ref, dx_acc,
     *, block_t: int, block_v: int, n_v: int, inv_n: float, v_true: int,
+    n_true: int,
 ):
+    ti = pl.program_id(0)
     vi = pl.program_id(1)
 
     @pl.when(vi == 0)
@@ -120,6 +134,7 @@ def _dx_kernel(
     p = jnp.exp(logits - lse)  # exactly 0 at padded columns
     labels = lab_ref[...][:, :1]
     dp = (p - jnp.where(cols == labels, 1.0, 0.0)) * inv_n
+    dp = _row_mask(dp, ti, block_t=block_t, n_true=n_true)
     dx_acc[:] = dx_acc[:] + jax.lax.dot_general(
         dp, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -132,6 +147,7 @@ def _dx_kernel(
 def _dw_kernel(
     x_ref, w_ref, lab_ref, lse_ref, dw_ref, dw_acc,
     *, block_t: int, block_v: int, n_t: int, inv_n: float, v_true: int,
+    n_true: int,
 ):
     vi = pl.program_id(0)
     ti = pl.program_id(1)
@@ -147,6 +163,7 @@ def _dw_kernel(
     p = jnp.exp(logits - lse)  # exactly 0 at padded columns
     labels = lab_ref[...][:, :1]
     dp = (p - jnp.where(cols == labels, 1.0, 0.0)) * inv_n
+    dp = _row_mask(dp, ti, block_t=block_t, n_true=n_true)
     # dW_tile += dP^T @ X : (block_v, D)
     dw_acc[:] = dw_acc[:] + jax.lax.dot_general(
         dp, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -158,20 +175,28 @@ def _dw_kernel(
 
 
 def _blocks(n: int, v: int, block_t: int, block_v: int):
-    """Token/vocab tiling.  Vocab sizes with no good divisor (GPT-2's
-    50257 = 7*43*167 would shrink block_v to 1 — a 50k-step grid) are
-    PADDED up to a block multiple instead; the kernels mask the padded
-    columns to -inf (``_logits_tile``), so they vanish from the softmax
-    and every gradient, and the wrapper slices dW back to the true rows.
-    Returns (bt, bv, n_t, n_v, v_pad)."""
+    """Token/vocab tiling.  Dimensions with no good divisor are PADDED
+    up to a block multiple instead of shrinking the block (GPT-2's vocab
+    50257 = 7*43*167 would shrink block_v to 1 — a 50k-step grid; a
+    prime token count does the same to block_t): padded vocab columns
+    are masked to -inf in-kernel (``_logits_tile``) and padded token
+    rows are zeroed out of dX/dW (``_row_mask``), so neither reaches
+    the softmax, the loss mean, or any gradient; the wrappers slice
+    dW/dX back to the true extents.
+    Returns (bt, bv, n_t, n_v, v_pad, n_pad)."""
     bt = _shrink_block(block_t, n)
+    if bt < 8 and n > 8:  # same hazard on the token dim (odd batch*seq)
+        bt = block_t
+        n_pad = -(-n // bt) * bt
+    else:
+        n_pad = n
     bv = _shrink_block(block_v, v)
     if bv < 128 and v > 128:
         bv = block_v  # honor the caller's tile bound; pad V up to it
         v_pad = -(-v // bv) * bv
     else:
         v_pad = v
-    return bt, bv, n // bt, v_pad // bv, v_pad
+    return bt, bv, n_pad // bt, v_pad // bv, v_pad, n_pad
 
 
 def _broadcast_lanes(a):
@@ -187,9 +212,12 @@ def _fused_ce(x, w, labels, block_t, block_v, interpret):
 def _fused_ce_fwd_impl(x, w, labels, block_t, block_v, interpret):
     n, d = x.shape
     v = w.shape[0]
-    bt, bv, n_t, n_v, v_pad = _blocks(n, v, block_t, block_v)
+    bt, bv, n_t, n_v, v_pad, n_pad = _blocks(n, v, block_t, block_v)
     if v_pad != v:
         w = jnp.pad(w, ((0, v_pad - v), (0, 0)))
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        labels = jnp.pad(labels, (0, n_pad - n))
     lab_b = _broadcast_lanes(labels.astype(jnp.int32))
     res_spec = pl.BlockSpec((bt, _RES_LANES), lambda ti, vi: (ti, 0))
     loss_rows, lse = pl.pallas_call(
@@ -204,8 +232,8 @@ def _fused_ce_fwd_impl(x, w, labels, block_t, block_v, interpret):
         ],
         out_specs=[res_spec, res_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((n, _RES_LANES), jnp.float32),
-            jax.ShapeDtypeStruct((n, _RES_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, _RES_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, _RES_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bt, 1), jnp.float32),
@@ -217,7 +245,7 @@ def _fused_ce_fwd_impl(x, w, labels, block_t, block_v, interpret):
         ),
         interpret=interpret,
     )(x, w, lab_b)
-    return jnp.mean(loss_rows[:, 0]), lse
+    return jnp.mean(loss_rows[:n, 0]), lse
 
 
 def _fused_ce_fwd(x, w, labels, block_t, block_v, interpret):
@@ -229,9 +257,12 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
     x, w, labels, lse = res
     n, d = x.shape
     v = w.shape[0]
-    bt, bv, n_t, n_v, v_pad = _blocks(n, v, block_t, block_v)
+    bt, bv, n_t, n_v, v_pad, n_pad = _blocks(n, v, block_t, block_v)
     if v_pad != v:
         w = jnp.pad(w, ((0, v_pad - v), (0, 0)))
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        labels = jnp.pad(labels, (0, n_pad - n))
     inv_n = 1.0 / n
     lab_b = _broadcast_lanes(labels.astype(jnp.int32))
     res_spec_t = pl.BlockSpec((bt, _RES_LANES), lambda ti, vi: (ti, 0))
@@ -239,7 +270,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
     dx = pl.pallas_call(
         functools.partial(
             _dx_kernel, block_t=bt, block_v=bv, n_v=n_v, inv_n=inv_n,
-            v_true=v,
+            v_true=v, n_true=n,
         ),
         grid=(n_t, n_v),
         in_specs=[
@@ -249,7 +280,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
             res_spec_t,
         ],
         out_specs=pl.BlockSpec((bt, d), lambda ti, vi: (ti, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
@@ -261,7 +292,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
     dw = pl.pallas_call(
         functools.partial(
             _dw_kernel, block_t=bt, block_v=bv, n_t=n_t, inv_n=inv_n,
-            v_true=v,
+            v_true=v, n_true=n,
         ),
         grid=(n_v, n_t),
         in_specs=[
@@ -281,6 +312,8 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
 
     if v_pad != v:
         dw = dw[:v]  # padded rows carry exact zeros; drop them
+    if n_pad != n:
+        dx = dx[:n]
     gf = g.astype(jnp.float32)
     return (
         (dx.astype(jnp.float32) * gf).astype(x.dtype),
@@ -312,10 +345,11 @@ def fused_linear_cross_entropy(
     Exactly ``nn.functional.cross_entropy(x @ w.T, labels)`` up to f32
     accumulation order (parity pinned in tests/test_fused_ce.py).
     Differentiable in ``x`` and ``w``.  ``block_t``/``block_v`` are upper
-    bounds shrunk to divide the flattened token count / vocab; a vocab
-    with no divisor >= 128 (GPT-2's 50257) is instead PADDED up to a
-    ``block_v`` multiple, with the padded columns masked in-kernel and
-    dW sliced back to the true rows.
+    bounds shrunk to divide the flattened token count / vocab; a
+    dimension with no good divisor (GPT-2's 50257-entry vocab, a prime
+    token count) is instead PADDED up to a block multiple, with the
+    padded columns/rows masked in-kernel and dW/dX sliced back to the
+    true extents.
     """
     d = x.shape[-1]
     if w.ndim != 2 or w.shape[1] != d:
