@@ -70,7 +70,7 @@ def main() -> None:
     # 2. the train step: tokens sharded over sp on the SEQUENCE dim; the
     #    model's attention communicates over the sp axis internally, so
     #    the whole step is one shard_map
-    from jax import shard_map
+    from torchdistx_tpu.parallel.compat import shard_map
 
     def loss_fn(p, tokens, labels):
         logits = functional_call(model, p, (tokens,))

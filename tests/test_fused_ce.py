@@ -134,7 +134,7 @@ def test_model_hidden_path_matches_logits(family):
 def test_sequence_parallel_shard_map(mesh8):
     # per-shard fused CE + pmean == global CE (equal shard sizes), in
     # value and in grads — the loss SP training composes with
-    from jax import shard_map
+    from torchdistx_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n, d, v = 512, 64, 256
@@ -204,6 +204,35 @@ def test_prime_token_count_padding():
 
     n, d, v = 509, 32, 512
     x, w, y = _mk(n, d, v, jnp.float32, seed=8)
+    loss_f = fused_linear_cross_entropy(x, w, y)
+    np.testing.assert_allclose(float(loss_f), float(_ref(x, w, y)),
+                               rtol=1e-5)
+    gx_f, gw_f = jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, y), argnums=(0, 1)
+    )(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: _ref(x, w, y), argnums=(0, 1)
+    )(x, w)
+    assert gx_f.shape == (n, d)
+    for a, b in ((gx_f, gx_r), (gw_f, gw_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_tiny_token_count_pads_to_sublane_minimum():
+    # n < 8 divides itself, so neither the shrink nor the n > 8 padding
+    # path fired — compiled Mosaic would get a <8-sublane block.  _blocks
+    # must pad tiny token counts up to one 8-row block, and the padded
+    # rows must vanish from the loss mean and both gradients
+    from torchdistx_tpu.ops.fused_ce import _blocks
+
+    for n in (1, 3, 7):
+        bt, bv, n_t, n_v, v_pad, n_pad = _blocks(n, 512, 256, 512)
+        assert bt == 8 and n_pad == 8 and n_t == 1
+    bt, _, n_t, _, _, n_pad = _blocks(8, 512, 256, 512)
+    assert bt == 8 and n_pad == 8 and n_t == 1  # exactly 8 needs no pad
+
+    n, d, v = 3, 32, 512
+    x, w, y = _mk(n, d, v, jnp.float32, seed=9)
     loss_f = fused_linear_cross_entropy(x, w, y)
     np.testing.assert_allclose(float(loss_f), float(_ref(x, w, y)),
                                rtol=1e-5)

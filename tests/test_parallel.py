@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from torchdistx_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import torchdistx_tpu as tdx
@@ -637,3 +637,49 @@ class TestShardedAccumulation:
                 atol=3e-7,
                 err_msg=k,
             )
+
+
+class TestOptimizerStateShardings:
+    def test_mismatched_shape_state_replicates(self, mesh8):
+        # a factored optimizer (Adafactor-style row/col second moments)
+        # keeps the param tree's PATHS with differently shaped leaves —
+        # the path-subset heuristic alone would hand those the param's
+        # PartitionSpec, mis-sharding (or failing to apply to) them.
+        # Shape-mismatched leaves must fall back to replicated; exactly
+        # sized siblings still inherit.
+        from jax.sharding import NamedSharding
+        from torchdistx_tpu.parallel.fsdp import optimizer_state_shardings
+
+        params = {
+            "w": jax.device_put(
+                jnp.zeros((64, 8)), NamedSharding(mesh8, P("fsdp"))
+            ),
+            "b": jax.device_put(jnp.zeros((8,)), NamedSharding(mesh8, P())),
+        }
+        state_shape = {
+            # row/col factors: param paths, wrong sizes
+            "factored": {
+                "w": jax.ShapeDtypeStruct((64,), jnp.float32),
+                "b": jax.ShapeDtypeStruct((1,), jnp.float32),
+            },
+            # full-size moments: param paths, exact sizes
+            "moments": {
+                "w": jax.ShapeDtypeStruct((64, 8), jnp.float32),
+                "b": jax.ShapeDtypeStruct((8,), jnp.float32),
+            },
+            # mixed subtree: one exact leaf, one factored — the gate is
+            # per leaf, so the exact sibling keeps its param sharding
+            "mixed": {
+                "w": jax.ShapeDtypeStruct((64, 8), jnp.float32),
+                "b": jax.ShapeDtypeStruct((1,), jnp.float32),
+            },
+            "count": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        sh = optimizer_state_shardings(state_shape, params, mesh8)
+        assert sh["factored"]["w"].spec == P()
+        assert sh["factored"]["b"].spec == P()
+        assert sh["moments"]["w"].spec == P("fsdp")
+        assert sh["moments"]["b"].spec == P()
+        assert sh["mixed"]["w"].spec == P("fsdp")
+        assert sh["mixed"]["b"].spec == P()
+        assert sh["count"].spec == P()

@@ -1,0 +1,417 @@
+"""Continuous-batching serving engine (torchdistx_tpu.serve).
+
+The load-bearing invariants, pinned on the 8-device CPU mesh:
+
+- **Exactness**: a greedy request served through the slot cache is
+  bit-identical to ``generation.generate`` on that prompt alone — padding,
+  slot reuse, and batch-mates change nothing.
+- **Dispatch discipline**: a full mixed-length continuous-batching run —
+  including a late request admitted into a freed (dirty) slot — compiles
+  exactly two programs (one prefill bucket + one decode step).
+- **Deadlines**: expiry returns a partial result flagged ``truncated``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu.generation import generate
+from torchdistx_tpu.models import GPT2, Llama
+from torchdistx_tpu.serve import Request, Scheduler, ServeEngine, SlotKVCache
+from torchdistx_tpu.serve.metrics import Histogram, ServeMetrics
+
+
+def _llama():
+    tdx.manual_seed(0)
+    return Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+
+
+def _gpt2():
+    tdx.manual_seed(11)
+    return GPT2.from_name("tiny")
+
+
+def _prompts(seed, lengths):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 256, (n,)).astype(np.int32) for n in lengths]
+
+
+class TestSlotDecodeParity:
+    """forward_decode (per-row positions) row-for-row equals
+    forward_cached (scalar position) — the primitive the engine's
+    bit-identity rests on."""
+
+    def test_slot_attention_matches_scalar_cached_attention(self):
+        from torchdistx_tpu.ops.attention import (
+            cached_attention,
+            slot_cached_attention,
+        )
+
+        rs = np.random.RandomState(3)
+        b, hq, hkv, d, max_seq = 3, 4, 2, 8, 16
+        q = jnp.asarray(rs.randn(b, 1, hq, d), jnp.float32)
+        k = jnp.asarray(rs.randn(b, 1, hkv, d), jnp.float32)
+        v = jnp.asarray(rs.randn(b, 1, hkv, d), jnp.float32)
+        cache = (
+            jnp.asarray(rs.randn(b, max_seq, hkv, d), jnp.float32),
+            jnp.asarray(rs.randn(b, max_seq, hkv, d), jnp.float32),
+        )
+        positions = np.array([2, 9, 5], np.int32)
+        out, (ck, cv) = slot_cached_attention(
+            q, k, v, cache, jnp.asarray(positions)
+        )
+        for row, p in enumerate(positions):
+            r = slice(row, row + 1)
+            ref, (rk, rv) = cached_attention(
+                q[r], k[r], v[r],
+                (cache[0][r], cache[1][r]), int(p), use_flash=False,
+            )
+            np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+            np.testing.assert_array_equal(np.asarray(ck[r]), np.asarray(rk))
+            np.testing.assert_array_equal(np.asarray(cv[r]), np.asarray(rv))
+
+    def test_model_forward_decode_matches_forward_cached(self):
+        for model in (_llama(), _gpt2()):
+            rs = np.random.RandomState(4)
+            toks = jnp.asarray(rs.randint(0, 256, (3, 1)), jnp.int32)
+            positions = np.array([1, 7, 4], np.int32)
+            caches = [model.init_cache(1, 16) for _ in range(3)]
+            # place a little real content at each row's depth
+            seeded = []
+            for row, p in enumerate(positions):
+                pre = jnp.asarray(
+                    rs.randint(0, 256, (1, int(p))), jnp.int32
+                )
+                _, c = model.forward_cached(pre, caches[row], 0)
+                seeded.append(c)
+            big = [
+                (
+                    jnp.concatenate([c[i][0] for c in seeded]),
+                    jnp.concatenate([c[i][1] for c in seeded]),
+                )
+                for i in range(len(seeded[0]))
+            ]
+            logits, _ = model.forward_decode(
+                toks, big, jnp.asarray(positions)
+            )
+            for row, p in enumerate(positions):
+                r = slice(row, row + 1)
+                ref, _ = model.forward_cached(toks[r], seeded[row], int(p))
+                np.testing.assert_array_equal(
+                    np.asarray(logits[r]), np.asarray(ref)
+                )
+
+
+class TestServeExactness:
+    def test_greedy_bit_identical_to_sequential_generate(self):
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=3, max_len=64, prefill_buckets=(16,)
+        )
+        prompts = _prompts(0, (6, 11, 9, 4, 13))
+        results = engine.run(
+            [{"prompt": p, "max_new_tokens": 8} for p in prompts]
+        )
+        for p, r in zip(prompts, results):
+            assert r.finish_reason == "length" and not r.truncated
+            ref = np.asarray(generate(model, jnp.asarray(p[None]), 8))[0]
+            np.testing.assert_array_equal(
+                np.concatenate([p, r.tokens]), ref
+            )
+
+    def test_greedy_row_unaffected_by_sampling_batchmate(self):
+        model = _gpt2()
+        prompts = _prompts(1, (5, 7))
+        engine = ServeEngine(model, num_slots=2, max_len=32)
+        greedy = engine.submit(prompts[0], max_new_tokens=6)
+        engine.submit(
+            prompts[1], max_new_tokens=6, temperature=1.0, seed=3
+        )
+        while engine.step():
+            pass
+        ref = np.asarray(generate(model, jnp.asarray(prompts[0][None]), 6))[0]
+        np.testing.assert_array_equal(
+            np.concatenate([prompts[0], greedy.result().tokens]), ref
+        )
+
+    def test_sampling_reproducible_per_seed(self):
+        model = _gpt2()
+        prompt = _prompts(2, (6,))[0]
+        engine = ServeEngine(model, num_slots=2, max_len=32, top_k=50)
+
+        def sample(seed):
+            h = engine.submit(
+                prompt, max_new_tokens=6, temperature=0.8, seed=seed
+            )
+            while not h.done():
+                engine.step()
+            return h.result().tokens
+
+        a, b, c = sample(7), sample(7), sample(8)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_eos_stops_with_stop_reason(self):
+        model = _llama()
+        prompt = _prompts(3, (5,))[0]
+        first = np.asarray(generate(model, jnp.asarray(prompt[None]), 1))[
+            0, -1
+        ]
+        engine = ServeEngine(
+            model, num_slots=1, max_len=64, eos_token=int(first)
+        )
+        r = engine.run([{"prompt": prompt, "max_new_tokens": 8}])[0]
+        assert r.finish_reason == "stop" and not r.truncated
+        np.testing.assert_array_equal(r.tokens, [int(first)])
+
+
+class TestContinuousBatching:
+    def test_late_admit_into_freed_slot_no_recompile(self):
+        """Mixed lengths, staggered finishes, a late submit landing in a
+        freed (dirty) slot — and the jit cache holds exactly TWO programs
+        throughout (one prefill bucket, one decode step)."""
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,)
+        )
+        prompts = _prompts(5, (4, 9, 7))
+        h0 = engine.submit(prompts[0], max_new_tokens=3)
+        h1 = engine.submit(prompts[1], max_new_tokens=12)
+        while not h0.done():
+            engine.step()
+        assert not h1.done()  # slot 1 still decoding
+        warm = engine.num_compiled_programs()
+        if warm is None:
+            pytest.skip("jit cache introspection unavailable on this jax")
+        assert warm == 2  # one prefill bucket + one decode step
+        # late arrival: must reuse h0's freed slot while h1 keeps going
+        h2 = engine.submit(prompts[2], max_new_tokens=6)
+        while engine.step():
+            pass
+        assert engine.num_compiled_programs() == warm == 2
+        for p, h, n in ((prompts[1], h1, 12), (prompts[2], h2, 6)):
+            ref = np.asarray(generate(model, jnp.asarray(p[None]), n))[0]
+            np.testing.assert_array_equal(
+                np.concatenate([p, h.result().tokens]), ref
+            )
+        snap = engine.metrics.snapshot()
+        assert snap["requests_completed"] == 3
+        assert snap["tokens_generated"] == 3 + 12 + 6
+
+    def test_queue_deeper_than_slots_drains_fcfs(self):
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,)
+        )
+        prompts = _prompts(6, (3, 5, 7, 4, 6, 8))
+        results = engine.run(
+            [{"prompt": p, "max_new_tokens": 4} for p in prompts]
+        )
+        assert [r.rid for r in results] == sorted(r.rid for r in results)
+        for p, r in zip(prompts, results):
+            ref = np.asarray(generate(model, jnp.asarray(p[None]), 4))[0]
+            np.testing.assert_array_equal(
+                np.concatenate([p, r.tokens]), ref
+            )
+        assert engine.num_compiled_programs() in (2, None)
+
+    def test_max_tokens_budget_defers_admission(self):
+        model = _llama()
+        engine = ServeEngine(
+            model,
+            num_slots=2,
+            max_len=64,
+            prefill_buckets=(16,),
+            max_tokens_in_flight=20,
+        )
+        prompts = _prompts(7, (6, 6))
+        engine.submit(prompts[0], max_new_tokens=8)  # cost 14
+        h1 = engine.submit(prompts[1], max_new_tokens=8)  # would be 28 > 20
+        engine.step()
+        assert engine.scheduler.queue_depth == 1  # deferred, slot free
+        while engine.step():
+            pass
+        assert h1.done()  # admitted after the first retired
+        ref = np.asarray(generate(model, jnp.asarray(prompts[1][None]), 8))[0]
+        np.testing.assert_array_equal(
+            np.concatenate([prompts[1], h1.result().tokens]), ref
+        )
+
+
+class TestDeadlines:
+    def test_running_deadline_returns_truncated_partial(self):
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=1, max_len=64, prefill_buckets=(16,)
+        )
+        prompt = _prompts(8, (5,))[0]
+        h = engine.submit(prompt, max_new_tokens=40, deadline_s=0.2)
+        engine.step()  # prefill + first decode: some tokens exist
+        engine.step()
+        time.sleep(0.25)
+        engine.step()  # past deadline now
+        r = h.result()
+        assert r.finish_reason == "deadline" and r.truncated
+        assert 0 < len(r.tokens) < 40
+        # the partial prefix is still exact
+        ref = np.asarray(
+            generate(model, jnp.asarray(prompt[None]), len(r.tokens))
+        )[0]
+        np.testing.assert_array_equal(np.concatenate([prompt, r.tokens]), ref)
+        assert engine.metrics.snapshot()["requests_truncated"] == 1
+
+    def test_queued_deadline_expires_with_no_tokens(self):
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=1, max_len=64, prefill_buckets=(16,)
+        )
+        prompts = _prompts(9, (5, 6))
+        engine.submit(prompts[0], max_new_tokens=30)
+        h = engine.submit(prompts[1], max_new_tokens=4, deadline_s=0.0)
+        engine.step()
+        r = h.result()
+        assert r.truncated and r.finish_reason == "deadline"
+        assert r.tokens.size == 0
+
+
+class TestSchedulerUnit:
+    def _req(self, n=4, **kw):
+        return Request(
+            rid=-1, prompt=np.zeros(n, np.int32), max_new_tokens=4, **kw
+        )
+
+    def test_fcfs_blocked_head_blocks_line(self):
+        s = Scheduler(num_slots=2, max_tokens_in_flight=16)
+        a, b, c = self._req(4), self._req(12), self._req(2)
+        for r in (a, b, c):
+            s.submit(r)
+        admitted = s.admit(now=0.0)
+        # a (cost 8) admitted; b (cost 16) over budget; c must NOT skip b
+        assert [r.rid for r, _ in admitted] == [a.rid]
+        assert s.queue_depth == 2
+        s.retire(a)
+        assert [r.rid for r, _ in s.admit(now=0.0)] == [b.rid]
+
+    def test_slots_reused_lowest_first(self):
+        s = Scheduler(num_slots=2)
+        a, b = self._req(), self._req()
+        s.submit(a), s.submit(b)
+        assert [slot for _, slot in s.admit(now=0.0)] == [0, 1]
+        s.retire(a)
+        c = self._req()
+        s.submit(c)
+        assert [slot for _, slot in s.admit(now=0.0)] == [0]
+
+    def test_retire_requires_running(self):
+        s = Scheduler(num_slots=1)
+        r = self._req()
+        s.submit(r)
+        with pytest.raises(ValueError, match="not running"):
+            s.retire(r)
+
+
+class TestKVCacheUnit:
+    def test_admit_retire_bookkeeping(self):
+        cache = SlotKVCache(_llama(), num_slots=2, max_len=16)
+        cache.admit(0, 5)
+        assert cache.active_count == 1 and cache.pos[0] == 5
+        with pytest.raises(ValueError, match="already active"):
+            cache.admit(0, 3)
+        cache.advance()
+        assert cache.pos[0] == 6 and cache.pos[1] == 0
+        cache.retire(0)
+        assert cache.active_count == 0
+        with pytest.raises(ValueError, match="outside"):
+            cache.admit(1, 17)
+
+    def test_positions_clamped_for_dead_slots(self):
+        cache = SlotKVCache(_llama(), num_slots=1, max_len=4)
+        cache.pos[0] = 9  # stale beyond geometry
+        assert cache.positions()[0] == 3
+
+
+class TestShardedParams:
+    def test_fsdp_sharded_params_serve_and_match_generate(self, mesh8):
+        # the advertised params= override with mesh-committed (FSDP)
+        # params: the slot cache must follow the params onto the mesh
+        # (replicated) or the first dispatch dies with an
+        # incompatible-devices jit error
+        from jax.sharding import NamedSharding
+
+        from torchdistx_tpu.parallel.fsdp import fsdp_partition_spec
+
+        model = _llama()
+        params = {
+            name: jax.device_put(
+                p,
+                NamedSharding(
+                    mesh8, fsdp_partition_spec(p.shape, mesh8, "fsdp")
+                ),
+            )
+            for name, p in model.named_parameters()
+        }
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,),
+            params=params,
+        )
+        prompts = _prompts(10, (6, 9))
+        results = engine.run(
+            [{"prompt": p, "max_new_tokens": 5} for p in prompts]
+        )
+        for p, r in zip(prompts, results):
+            assert r.finish_reason == "length"
+            ref = np.asarray(
+                generate(model, jnp.asarray(p[None]), 5, params=params)
+            )[0]
+            np.testing.assert_array_equal(
+                np.concatenate([p, r.tokens]), ref
+            )
+
+
+class TestValidation:
+    def test_submit_rejects_oversized_and_empty(self):
+        engine = ServeEngine(_llama(), num_slots=1, max_len=32)
+        with pytest.raises(ValueError, match="exceeds the slot cache"):
+            engine.submit(np.zeros(30, np.int32), max_new_tokens=10)
+        with pytest.raises(ValueError, match="at least one token"):
+            engine.submit(np.zeros(0, np.int32), max_new_tokens=4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(np.zeros(4, np.int32), max_new_tokens=0)
+
+    def test_engine_rejects_bad_geometry(self):
+        with pytest.raises(ValueError, match="exceeds the model"):
+            ServeEngine(_llama(), max_len=1024)
+        with pytest.raises(ValueError, match="top_k"):
+            ServeEngine(_llama(), max_len=32, top_k=0)
+
+
+class TestMetricsUnit:
+    def test_histogram_snapshot(self):
+        h = Histogram()
+        assert h.snapshot()["count"] == 0
+        for v in range(1, 101):
+            h.record(float(v))
+        s = h.snapshot()
+        assert s["count"] == 100 and s["max"] == 100.0
+        assert abs(s["mean"] - 50.5) < 1e-9
+        assert 49 <= s["p50"] <= 52 and 94 <= s["p95"] <= 97
+
+    def test_snapshot_is_json_serializable(self):
+        import json
+
+        m = ServeMetrics(num_slots=4)
+        m.count("tokens_generated", 9)
+        m.count("tokens_decoded", 7)  # 2 of the 9 rode prefill dispatches
+        m.observe_gauges(queue_depth=2, active_slots=3)
+        m.decode_s.record(0.5)
+        snap = m.snapshot()
+        parsed = json.loads(json.dumps(snap))
+        assert parsed["tokens_generated"] == 9
+        assert parsed["queue_depth"] == 2
+        assert parsed["slot_occupancy_mean"] == 0.75
+        # decode throughput excludes prefill-sampled tokens
+        assert parsed["decode_tokens_per_sec"] == 14.0

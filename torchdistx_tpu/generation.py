@@ -68,6 +68,41 @@ def _make_sampler(
     return sample
 
 
+def _make_slot_sampler(
+    out_dtype,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """Per-row sampler for continuous-batching decode (``serve.engine``):
+    ``sample(logits, temps, seeds, steps)`` with ``logits`` (B, V) and the
+    rest (B,) — rows with ``temps[b] <= 0`` take the greedy branch, the
+    rest sample at their own temperature from the key
+    ``fold_in(PRNGKey(seeds[b]), steps[b])``.  Keying on (request seed,
+    per-request token index) makes a request's sampled stream reproducible
+    no matter which slot it lands in or what else is in flight.
+    Temperature/seed/step are DYNAMIC inputs (one compiled program serves
+    any greedy/sampling slot mix); ``top_k``/``top_p`` reuse
+    ``_make_sampler``'s filters and stay static.  A greedy row is
+    bit-identical to ``_make_sampler(0.0, ...)``."""
+
+    def sample(logits, temps, seeds, steps):
+        greedy = jnp.argmax(logits, axis=-1).astype(out_dtype)
+        scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+        if top_k is not None:
+            scaled = _apply_top_k(scaled, top_k)
+        if top_p is not None:
+            scaled = _apply_top_p(scaled, top_p)
+        keys = jax.vmap(
+            lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+        )(seeds, steps)
+        drawn = jax.vmap(jax.random.categorical)(keys, scaled).astype(
+            out_dtype
+        )
+        return jnp.where(temps > 0.0, drawn, greedy)
+
+    return sample
+
+
 def _decode_tokens(
     apply_step: Callable[[jax.Array, Any, Any], tuple],
     sample,

@@ -15,8 +15,13 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn import init
-from ..ops.attention import cached_attention, multihead_attention
+from ..ops.attention import (
+    cached_attention,
+    multihead_attention,
+    slot_cached_attention,
+)
 from ..ops.flash_attention import resolve_use_flash
+from ..parallel.compat import axis_size
 
 __all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
 
@@ -123,6 +128,20 @@ class GPT2Block(nn.Module):
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache
 
+    def forward_decode(self, x, cache, positions):
+        """One-token batched decode with PER-ROW cache positions (serving
+        slots) — the ``slot_cached_attention`` sibling of
+        ``forward_cached``."""
+        b, s, d = x.shape
+        hd = d // self.n_heads
+        h = self.ln1(x)
+        qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a, cache = slot_cached_attention(q, k, v, cache, positions)
+        x = x + self.attn_out(a.reshape(b, s, d))
+        h = self.ln2(x)
+        return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache
+
 
 class GPT2(nn.Module):
     def __init__(self, cfg: GPT2Config):
@@ -150,7 +169,7 @@ class GPT2(nn.Module):
             import jax
 
             # s is the LOCAL shard; positions are global (shard offset)
-            n = jax.lax.axis_size(self.cfg.sp_axis)
+            n = axis_size(self.cfg.sp_axis)
             if s * n > self.cfg.n_positions:
                 raise ValueError(
                     f"global sequence length {s * n} exceeds n_positions="
@@ -197,6 +216,19 @@ class GPT2(nn.Module):
         new_cache = []
         for blk, c in zip(self.blocks, cache):
             x, c = blk.forward_cached(x, c, cache_pos)
+            new_cache.append(c)
+        x = self.ln_f(x)
+        return x @ self.tok_emb.weight.T, new_cache
+
+    def forward_decode(self, tokens, cache, positions):
+        """One decode step for a batch of independent serving slots:
+        ``tokens`` (B, 1), ``positions`` (B,) int32 per-row cache depths.
+        Returns (logits, new_cache); same cache pytree as
+        ``forward_cached``."""
+        x = self.tok_emb(tokens) + self.pos_emb(positions)[:, None]
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk.forward_decode(x, c, positions)
             new_cache.append(c)
         x = self.ln_f(x)
         return x @ self.tok_emb.weight.T, new_cache

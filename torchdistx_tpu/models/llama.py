@@ -24,6 +24,7 @@ from ..nn import init as nn_init
 from ..ops.attention import (
     cached_attention,
     multihead_attention,
+    slot_cached_attention,
     sp_attention,
 )
 from ..ops.flash_attention import resolve_use_flash
@@ -174,6 +175,19 @@ def apply_rope(x: jax.Array, rope: jax.Array, offset=0) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def apply_rope_at(x: jax.Array, rope: jax.Array, positions: jax.Array) -> jax.Array:
+    """x: (B, 1, H, D); ``positions``: (B,) int32 — PER-ROW rotary offsets
+    (continuous-batching decode: each batch row is a serving slot at its
+    own depth).  Row ``b`` gets the same rotation ``apply_rope`` would
+    apply at scalar offset ``positions[b]``."""
+    window = jnp.take(rope, positions, axis=0)  # (B, D/2, 2)
+    cos = window[:, None, None, :, 0]
+    sin = window[:, None, None, :, 1]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 class LlamaAttention(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -240,6 +254,22 @@ class LlamaAttention(nn.Module):
         )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 
+    def forward_decode(self, x, rope, cache, positions):
+        """One-token batched decode with PER-ROW cache positions (serving
+        slots): ``x`` is (B, 1, dim), ``positions`` (B,) int32.  Same math
+        as ``forward_cached`` at ``s == 1``, row for row."""
+        b, s, _ = x.shape
+        cfg = self.cfg
+        q = self.wq(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = self.wk(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = self.wv(x).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope_at(q, rope, positions)
+        k = apply_rope_at(k, rope, positions)
+        out, cache = slot_cached_attention(
+            q, k, v, cache, positions, window=cfg.sliding_window
+        )
+        return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
+
 
 class LlamaMLP(nn.Module):
     def __init__(self, cfg: LlamaConfig):
@@ -272,6 +302,13 @@ class LlamaBlock(nn.Module):
     def forward_cached(self, x, rope, cache, cache_pos):
         a, cache = self.attn.forward_cached(
             self.attn_norm(x), rope, cache, cache_pos
+        )
+        x = x + a
+        return x + self.mlp(self.mlp_norm(x)), cache
+
+    def forward_decode(self, x, rope, cache, positions):
+        a, cache = self.attn.forward_decode(
+            self.attn_norm(x), rope, cache, positions
         )
         x = x + a
         return x + self.mlp(self.mlp_norm(x)), cache
@@ -350,6 +387,23 @@ class Llama(nn.Module):
         new_cache = []
         for blk, c in zip(self.blocks, cache):
             x, c = blk.forward_cached(x, rope, c, cache_pos)
+            new_cache.append(c)
+        x = self.norm(x)
+        return self.lm_head(x), new_cache
+
+    def forward_decode(self, tokens, cache, positions):
+        """One decode step for a batch of independent serving slots:
+        ``tokens`` (B, 1), ``positions`` (B,) int32 — row ``b``'s token
+        is written at its own cache depth ``positions[b]``
+        (``ops.attention.slot_cached_attention``).  Returns (logits,
+        new_cache); same cache-ins/cache-outs pytree as
+        ``forward_cached``."""
+        cfg = self.cfg
+        x = self.tok_emb(tokens)
+        rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk.forward_decode(x, rope, c, positions)
             new_cache.append(c)
         x = self.norm(x)
         return self.lm_head(x), new_cache

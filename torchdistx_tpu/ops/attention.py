@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..parallel.compat import axis_size
+
 __all__ = [
     "multihead_attention",
     "sp_attention",
@@ -29,6 +31,7 @@ __all__ = [
     "ring_flash_attention",
     "ulysses_attention",
     "cached_attention",
+    "slot_cached_attention",
 ]
 
 
@@ -160,6 +163,67 @@ def cached_attention(
     return out, (ck, cv)
 
 
+def slot_cached_attention(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    cache: tuple,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+):
+    """Single-token batched decode where each batch row sits at its OWN
+    cache depth — the continuous-batching sibling of
+    :func:`cached_attention` (whose ``cache_pos`` is one scalar for the
+    whole batch).  Rows are independent serving *slots*: row ``b``'s new
+    K/V are written at ``positions[b]`` and its query attends cache
+    slots ``j <= positions[b]``.
+
+    ``q``/``k_new``/``v_new``: (B, 1, H, D) projections of each slot's
+    next token (positional encoding already applied at that slot's own
+    position).  ``cache`` is ``(k, v)`` of shape (B, max_seq, Hkv, D);
+    ``positions`` is (B,) int32.  Row-for-row this is exactly the
+    ``s == 1`` path of :func:`cached_attention` (same write, same
+    visibility rule, f32 softmax), so a slot's decode stream is
+    bit-identical to single-request decode at the same position.
+    GQA-aware; ``window`` applies the same end-aligned sliding band as
+    the scalar path.  Returns (out, (ck, cv)).
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(
+            f"slot_cached_attention decodes one token per slot, got S={s}"
+        )
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    ck, cv = cache
+    write = lambda c, x, p: lax.dynamic_update_slice(  # noqa: E731
+        c, x.astype(c.dtype), (p, 0, 0)
+    )
+    ck = jax.vmap(write)(ck, k_new, positions)
+    cv = jax.vmap(write)(cv, v_new, positions)
+    max_seq, hkv = ck.shape[1], ck.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # GQA broadcast mirrors the scalar path's _repeat_kv + einsum exactly.
+    # A grouped einsum (query heads folded onto their kv head) would skip
+    # materializing the repeated cache — measured here, it changes the
+    # contraction's bitwise result, and bit-identity with single-request
+    # decode is this primitive's contract (tests/test_serve.py); revisit
+    # together with the scalar path if that trade is renegotiated.
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+    slots = jnp.arange(max_seq)[None, :]
+    visible = slots <= positions[:, None]  # (B, max_seq)
+    if window is not None:
+        visible = visible & (slots > positions[:, None] - window)
+    logits = jnp.where(visible[:, None, None, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    return out, (ck, cv)
+
+
 def multihead_attention(
     q: jax.Array,
     k: jax.Array,
@@ -249,7 +313,7 @@ def ring_attention(
     parallelism).  The rotating block index selects each hop's column
     slice, so only O(S) bias per device is needed.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -406,7 +470,7 @@ def _ring_flash_fwd(
 ):
     from .flash_attention import _flash_forward
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -478,7 +542,7 @@ def _ring_flash_bwd_rule(
     q, k, v, bias, out, lse = res
     from .flash_attention import _prepare_flash_bwd
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -609,7 +673,7 @@ def ring_flash_attention(
     if bias is not None:
         _validate_ring_bias(
             "ring_flash_attention", bias, q.shape[2], q.shape[1],
-            lax.axis_size(axis), k.shape[1],
+            axis_size(axis), k.shape[1],
         )
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -645,7 +709,7 @@ def ulysses_attention(
     works when ``hkv % n == 0``); prefer the ring for very wide-group
     GQA or head counts that don't divide.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     if hq % n != 0 or hkv % n != 0:

@@ -26,6 +26,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.3x renamed pltpu.TPUCompilerParams -> CompilerParams; accept
+# whichever this jaxlib ships (one alias, used by every kernel here and
+# in fused_ce.py)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 __all__ = ["flash_attention", "rel_pos_bucket"]
 
 _NEG_INF = -1e30
@@ -593,7 +600,7 @@ def _flash_dtable(
         out_specs=table_spec,
         out_shape=jax.ShapeDtypeStruct((hq, buckets), table.dtype),
         scratch_shapes=[pltpu.VMEM((1, buckets), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 "parallel", "arbitrary", "arbitrary", "arbitrary"
             ),
@@ -767,7 +774,7 @@ def _flash_backward_core(
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -821,7 +828,7 @@ def _flash_backward_core(
         ),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), dq_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -875,7 +882,7 @@ def _flash_dbias(
         out_specs=bias_spec,
         out_shape=jax.ShapeDtypeStruct((hq, sq, skv), bias.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary"
             ),
@@ -1276,7 +1283,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -27,9 +27,14 @@ HBM traffic drops from ~5 logits-sized passes to three streams of W
 matmul FLOPs the unfused path already pays.
 
 Opt-in until compiled acceptance lands on a relay-alive window (the same
-gate the in-kernel bucket bias sits behind): ``use_fused_ce=`` on model
-loss helpers / ``TDX_BENCH_FUSED_CE=1`` in the bench, and the
-``fusedce`` phase of ``scripts/verify_kernels_onchip.py`` captures the
+gate the in-kernel bucket bias sits behind).  There is no config knob:
+callers ask the model for hidden states — ``model.forward(tokens,
+return_hidden=True)`` (Llama and GPT-2 both take it) — and call
+``fused_linear_cross_entropy(hidden, head_weight, labels)`` directly in
+their loss, where ``head_weight`` is ``lm_head.weight`` (GPT-2: the tied
+``tok_emb.weight``).  The bench workload flips to that path under
+``TDX_BENCH_FUSED_CE=1`` (utils/benchmarks.py), and the ``fusedce``
+phase of ``scripts/verify_kernels_onchip.py`` captures the
 compiled-vs-reference evidence.
 """
 
@@ -44,7 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _RES_LANES, _shrink_block
+from .flash_attention import _CompilerParams, _RES_LANES, _shrink_block
 
 __all__ = ["fused_linear_cross_entropy"]
 
@@ -185,7 +190,12 @@ def _blocks(n: int, v: int, block_t: int, block_v: int):
     dW/dX back to the true extents.
     Returns (bt, bv, n_t, n_v, v_pad, n_pad)."""
     bt = _shrink_block(block_t, n)
-    if bt < 8 and n > 8:  # same hazard on the token dim (odd batch*seq)
+    if n < 8:
+        # compiled Mosaic needs >= 8 sublanes per block: a tiny token
+        # count (n < 8 divides itself, so no shrink/pad path fired) must
+        # still pad up to one 8-row block
+        bt, n_pad = 8, 8
+    elif bt < 8:  # same hazard on the token dim (odd batch*seq)
         bt = block_t
         n_pad = -(-n // bt) * bt
     else:
@@ -240,7 +250,7 @@ def _fused_ce_fwd_impl(x, w, labels, block_t, block_v, interpret):
             pltpu.VMEM((bt, 1), jnp.float32),
             pltpu.VMEM((bt, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -282,7 +292,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
         out_specs=pl.BlockSpec((bt, d), lambda ti, vi: (ti, 0)),
         out_shape=jax.ShapeDtypeStruct((n_pad, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -304,7 +314,7 @@ def _fused_ce_bwd(block_t, block_v, interpret, res, g):
         out_specs=pl.BlockSpec((bv, d), lambda vi, ti: (vi, 0)),
         out_shape=jax.ShapeDtypeStruct((v_pad, d), w.dtype),
         scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

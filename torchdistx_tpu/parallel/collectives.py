@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .compat import axis_size as _axis_size
+
 __all__ = [
     "all_reduce",
     "all_mean",
@@ -118,7 +120,7 @@ def exchange(
 
 def shift(tree: Any, axis: str, offset: int = 1) -> Any:
     """Ring shift by ``offset`` (the ring-collective building block)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis, perm), tree)
 
@@ -188,4 +190,4 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    return _axis_size(axis)

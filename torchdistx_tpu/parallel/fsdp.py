@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from .comm_hooks import DefaultState, Hook, HookContext, allreduce_hook
 
@@ -121,7 +121,6 @@ def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
     without explicit out_shardings the whole optimizer state lands on one
     device regardless of how the parameters are sharded.
     """
-    pdef = jax.tree_util.tree_structure(params)
     repl = NamedSharding(mesh, P())
     psh = jax.tree_util.tree_map(
         lambda p: p.sharding if isinstance(p, jax.Array) else repl, params
@@ -134,20 +133,42 @@ def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
         jax.tree_util.keystr(path): sh
         for path, sh in jax.tree_util.tree_flatten_with_path(psh)[0]
     }
+    pshapes = {
+        jax.tree_util.keystr(path): getattr(leaf, "shape", None)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def shape_matches(path_str: str, leaf: Any) -> bool:
+        # a param-named leaf must also be param-SIZED to inherit the
+        # param's sharding: factored optimizers (Adafactor-style row/col
+        # second moments) keep the param's tree paths with differently
+        # shaped leaves, and the param's PartitionSpec would mis-shard
+        # (or outright fail to apply to) those
+        p_shape = pshapes.get(path_str)
+        l_shape = getattr(leaf, "shape", None)
+        return (
+            p_shape is None
+            or l_shape is None
+            or tuple(l_shape) == tuple(p_shape)
+        )
 
     def is_param_like(t: Any) -> bool:
-        if jax.tree_util.tree_structure(t) == pdef:
-            return True
         leaves = jax.tree_util.tree_flatten_with_path(t)[0]
         return bool(leaves) and all(
             jax.tree_util.keystr(p) in ppaths for p, _ in leaves
         )
 
     def shard_tree(t: Any) -> Any:
-        if jax.tree_util.tree_structure(t) == pdef:
-            return psh
+        # shape gating is PER LEAF, so one mis-sized leaf (a row factor)
+        # replicates only itself — its exactly-param-sized siblings in
+        # the same slot subtree keep their param shardings
         return jax.tree_util.tree_map_with_path(
-            lambda p, _: ppaths[jax.tree_util.keystr(p)], t
+            lambda p, leaf: (
+                ppaths[jax.tree_util.keystr(p)]
+                if shape_matches(jax.tree_util.keystr(p), leaf)
+                else repl
+            ),
+            t,
         )
 
     return jax.tree_util.tree_map(

@@ -1,0 +1,407 @@
+"""``ServeEngine``: continuous-batching inference over a slot KV cache.
+
+The eager-API-over-compiled-step split: the public surface
+(``submit(prompt, ...) -> RequestHandle``, ``step()``, ``run(requests)``)
+is plain host Python — queueing, slot assignment, deadline bookkeeping —
+while ALL device work flows through exactly two jitted programs:
+
+1. **Prefill** (one per padded bucket length): run one request's prompt —
+   padded up to the bucket — through the model's existing
+   ``forward_cached`` against a fresh single-request cache, sample the
+   first token from the last REAL prompt position, and
+   ``dynamic_update_slice`` the prefilled slab into the request's slot row
+   of the engine cache (``kv_cache.write_slot``).
+2. **Decode** (one, ever): one fused batched step over ALL slots — each
+   row at its own cache depth (``forward_decode`` /
+   ``ops.attention.slot_cached_attention``), per-slot temperature (a
+   dynamic input: any greedy/sampling mix shares the program), one sample
+   per slot.
+
+Admitting or retiring a request changes only tiny dynamic inputs
+(positions, temperatures, a slot index), never a compiled shape — the jit
+cache stays at two programs (plus one per extra bucket actually used) no
+matter how traffic churns.  Keeping the per-token dispatch count at ONE
+program is the same relay-dominated-dispatch constraint that motivated
+chunked replay (CLAUDE.md); a greedy slot's token stream is bit-identical
+to ``generation.generate`` on that prompt alone (pinned in
+tests/test_serve.py).
+
+Sampling (``generation._make_slot_sampler``) reuses ``generate``'s
+top-k/top-p filters; the two jitted programs live in the model's
+``generation._cached_jit`` store so executables are collected with the
+model.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..generation import _cached_jit, _check_sampling_args, _make_slot_sampler
+from ..nn.module import functional_call
+from ..utils.profiling import timed_annotation
+from .kv_cache import SlotKVCache, write_slot
+from .metrics import ServeMetrics
+from .scheduler import Request, RequestHandle, RequestResult, Scheduler
+
+__all__ = ["ServeEngine"]
+
+
+def _kv_placement(params: dict):
+    """Where the slot cache lives: REPLICATED over the params' mesh when
+    they are sharded (a cache committed to one device against mesh-
+    committed params is an incompatible-devices jit error), the default
+    device otherwise.  Sharding the cache itself is future work
+    (docs/serving.md)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for leaf in jax.tree_util.tree_leaves(params):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return NamedSharding(sh.mesh, PartitionSpec())
+    return None
+
+
+def _default_buckets(max_len: int) -> tuple:
+    """Powers of two from 16 up to (and covering) ``max_len``."""
+    buckets = []
+    b = 16
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+class ServeEngine:
+    """Continuous-batching serving engine over a slot-based KV cache.
+
+    Args:
+      model: a decoder-only model exposing ``init_cache``,
+        ``forward_cached`` and ``forward_decode`` (Llama and GPT-2 ship
+        all three).
+      num_slots: concurrent request capacity (the decode batch).
+      max_len: per-slot cache length; defaults to the model's maximum
+        sequence length.  ``prompt + max_new_tokens <= max_len`` is
+        enforced at submit.
+      eos_token: generation stops when a slot samples this id
+        (``finish_reason="stop"``); None decodes to ``max_new_tokens``.
+      top_k / top_p: engine-level static sampling filters (baked into the
+        compiled programs); per-request ``temperature`` is dynamic, with
+        0 = greedy.
+      prefill_buckets: padded prompt lengths; each bucket actually used
+        compiles one prefill program.  Default: powers of two up to
+        ``max_len``.
+      max_tokens_in_flight: admission budget over running requests'
+        ``prompt + max_new_tokens`` (default: unbounded).
+      params: parameter dict override (e.g. sharded params); default
+        ``dict(model.named_parameters())``.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        num_slots: int = 4,
+        max_len: Optional[int] = None,
+        eos_token: Optional[int] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_tokens_in_flight: Optional[int] = None,
+        params: Optional[dict] = None,
+    ):
+        _check_sampling_args(top_k, top_p)
+        cfg = getattr(model, "cfg", None)
+        limit = getattr(cfg, "max_seq_len", None) or getattr(
+            cfg, "n_positions", None
+        )
+        if max_len is None:
+            max_len = limit
+        if max_len is None:
+            raise ValueError(
+                "max_len is required for models without a sequence limit"
+            )
+        if limit is not None and max_len > limit:
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's maximum sequence "
+                f"length {limit}"
+            )
+        self.model = model
+        self.params = (
+            params if params is not None else dict(model.named_parameters())
+        )
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.eos_token = eos_token
+        self.top_k = top_k
+        self.top_p = top_p
+        if prefill_buckets is None:
+            buckets = _default_buckets(self.max_len)
+        else:
+            buckets = tuple(sorted(int(b) for b in prefill_buckets))
+            if not buckets or buckets[0] < 1:
+                raise ValueError(f"invalid prefill_buckets {prefill_buckets}")
+            if buckets[-1] > self.max_len:
+                raise ValueError(
+                    f"bucket {buckets[-1]} exceeds max_len {self.max_len}"
+                )
+            if buckets[-1] < self.max_len:
+                buckets = buckets + (self.max_len,)
+        self.prefill_buckets = buckets
+        self.cache = SlotKVCache(
+            model,
+            self.num_slots,
+            self.max_len,
+            placement=_kv_placement(self.params),
+        )
+        self.scheduler = Scheduler(self.num_slots, max_tokens_in_flight)
+        self.metrics = ServeMetrics(self.num_slots)
+        self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
+        self._last_tok = np.zeros(self.num_slots, np.int32)
+        self._temps = np.zeros(self.num_slots, np.float32)
+        self._seeds = np.zeros(self.num_slots, np.int32)
+        self._ntok = np.zeros(self.num_slots, np.int32)  # tokens sampled
+
+    # -- public API ------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> RequestHandle:
+        """Enqueue one request; returns immediately.  ``step()`` (or
+        ``run``) drives it to completion."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot cache length "
+                f"{self.max_len}"
+            )
+        req = Request(
+            rid=-1,
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            # the sampler keys on an int32 seed; mask wide (time/hash)
+            # seeds here rather than overflowing mid-step after the slot
+            # is already assigned
+            seed=int(seed) & 0x7FFFFFFF,
+            deadline_s=deadline_s,
+        )
+        self.scheduler.submit(req)
+        self.metrics.count("requests_submitted")
+        return RequestHandle(req)
+
+    def step(self) -> int:
+        """One scheduler tick: expire deadlines, admit new requests into
+        free slots (one prefill dispatch each), then run ONE fused decode
+        step over every slot.  Returns the number of unfinished requests
+        (queued + running)."""
+        now = time.monotonic()
+        for req in self.scheduler.expire_queued(now):
+            self._count_finish(req)
+        for req in list(self.scheduler.running):
+            if req.expired(now):
+                self._finish(req, "deadline", now)
+        for req, slot in self.scheduler.admit(now):
+            self._prefill_request(req, slot)
+        if self.scheduler.running:
+            self._decode_step()
+        self.metrics.observe_gauges(
+            self.scheduler.queue_depth, self.cache.active_count
+        )
+        return self.scheduler.queue_depth + len(self.scheduler.running)
+
+    def run(
+        self, requests: Iterable[Union[dict, Any]], *, max_new_tokens: int = 32
+    ) -> List[RequestResult]:
+        """Batch-offline mode: submit everything, step until drained,
+        return results in submission order.  Each request is either a
+        ``submit`` kwargs dict (``{"prompt": ..., "max_new_tokens": ...}``)
+        or a bare token sequence (decoded with ``max_new_tokens``)."""
+        handles = []
+        for r in requests:
+            if isinstance(r, dict):
+                handles.append(self.submit(**r))
+            else:
+                handles.append(self.submit(r, max_new_tokens=max_new_tokens))
+        while self.step():
+            pass
+        return [h.result() for h in handles]
+
+    def num_compiled_programs(self) -> Optional[int]:
+        """Compiled executables behind THIS engine's serving programs —
+        the dispatch-discipline invariant tests pin (one prefill per
+        bucket used + one decode).  Other engines on the same model (the
+        jit store lives on the model) have different static keys and are
+        excluded.  Returns None when jit cache introspection
+        (``_cache_size``, a private jax API) is unavailable — a count
+        that silently assumed one-compile-per-program would let a
+        per-step retrace regression pass the pinned invariant."""
+        static = self._static_key()
+        total = 0
+        for key, f in self.model.__dict__.get("_serve_jit_cache", {}).items():
+            if key[-len(static):] != static:
+                continue
+            cache_size = getattr(f, "_cache_size", None)
+            if cache_size is None:
+                return None
+            total += int(cache_size())
+        return total
+
+    # -- the two compiled programs ---------------------------------------
+
+    def _static_key(self) -> tuple:
+        return (self.num_slots, self.max_len, self.top_k, self.top_p)
+
+    def _prefill_program(self, bucket: int):
+        model, sampler = self.model, self._sampler
+
+        def build(params, kv, tokens, true_len, slot, temp, seed):
+            slab = model.init_cache(1, bucket)
+            logits, slab = functional_call(
+                model, params, (tokens, slab, 0), method="forward_cached"
+            )
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1
+            )[:, 0, :]
+            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+            return write_slot(kv, slab, slot), tok[0]
+
+        return _cached_jit(
+            model,
+            "_serve_jit_cache",
+            ("serve_prefill", bucket) + self._static_key(),
+            build,
+        )
+
+    def _decode_program(self):
+        model, sampler = self.model, self._sampler
+
+        def build(params, kv, toks, positions, temps, seeds, steps):
+            logits, kv = functional_call(
+                model, params, (toks, kv, positions), method="forward_decode"
+            )
+            return kv, sampler(logits[:, -1, :], temps, seeds, steps)
+
+        return _cached_jit(
+            model,
+            "_serve_jit_cache",
+            ("serve_decode",) + self._static_key(),
+            build,
+        )
+
+    # -- internals -------------------------------------------------------
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= length:
+                return b
+        raise ValueError(  # unreachable: submit bounds prompt < max_len
+            f"prompt length {length} exceeds the largest bucket"
+        )
+
+    def _prefill_request(self, req: Request, slot: int) -> None:
+        bucket = self._bucket_for(req.prompt.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : req.prompt.size] = req.prompt
+        program = self._prefill_program(bucket)
+        with timed_annotation("serve/prefill", self.metrics.prefill_s.record):
+            kv, tok = program(
+                self.params,
+                self.cache.kv,
+                jnp.asarray(padded),
+                jnp.int32(req.prompt.size),
+                jnp.int32(slot),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.seed], jnp.int32),
+            )
+            tok = int(np.asarray(tok))  # host sync: the first token exists
+        self.cache.kv = kv
+        self.cache.admit(slot, req.prompt.size)
+        self._last_tok[slot] = tok
+        self._temps[slot] = req.temperature
+        self._seeds[slot] = req.seed
+        self._ntok[slot] = 1
+        now = time.monotonic()
+        req.first_token_at = now
+        req.generated.append(tok)
+        self.metrics.count("prefill_calls")
+        self.metrics.count("requests_admitted")
+        self.metrics.count("tokens_prefilled", bucket)
+        self.metrics.count("tokens_generated")
+        self.metrics.ttft_s.record(now - req.submitted_at)
+        self.metrics.queue_wait_s.record((req.admitted_at or now) - req.submitted_at)
+        self._check_finished(req, tok, now)
+
+    def _decode_step(self) -> None:
+        running = self.scheduler.running
+        program = self._decode_program()
+        with timed_annotation("serve/decode", self.metrics.decode_s.record):
+            kv, out = program(
+                self.params,
+                self.cache.kv,
+                jnp.asarray(self._last_tok[:, None]),
+                jnp.asarray(self.cache.positions()),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._seeds),
+                jnp.asarray(self._ntok),
+            )
+            out = np.asarray(out)
+        self.cache.kv = kv
+        self._ntok[self.cache.active] += 1
+        self.cache.advance()  # every running slot cached one more token
+        self.metrics.count("decode_steps")
+        self.metrics.count("tokens_generated", len(running))
+        self.metrics.count("tokens_decoded", len(running))
+        now = time.monotonic()
+        for req in running:
+            tok = int(out[req.slot])
+            self._last_tok[req.slot] = tok
+            req.generated.append(tok)
+            self._check_finished(req, tok, now)
+
+    def _check_finished(self, req: Request, tok: int, now: float) -> bool:
+        if self.eos_token is not None and tok == self.eos_token:
+            self._finish(req, "stop", now)
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, "length", now)
+        elif self.cache.full(req.slot):
+            # no row left for another token; submit-time validation makes
+            # this unreachable today, but the geometry guard stays
+            self._finish(req, "cache_full", now)
+        else:
+            return False
+        return True
+
+    def _finish(self, req: Request, reason: str, now: float) -> None:
+        slot = req.slot
+        self.scheduler.retire(req)
+        self.cache.retire(slot)
+        self._temps[slot] = 0.0
+        req.finish_reason = reason
+        req.finished_at = now
+        self._count_finish(req)
+
+    def _count_finish(self, req: Request) -> None:
+        self.metrics.count("requests_completed")
+        if req.result().truncated:
+            self.metrics.count("requests_truncated")
+        self.metrics.e2e_latency_s.record(req.finished_at - req.submitted_at)

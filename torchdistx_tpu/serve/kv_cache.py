@@ -1,0 +1,138 @@
+"""Slot-based, fixed-geometry KV cache for continuous-batching serving.
+
+The whole cache is ONE static-shape pytree — per layer ``(k, v)`` arrays of
+shape ``(num_slots, max_len, heads, head_dim)`` (the model's own
+``init_cache(num_slots, max_len)`` layout, so ``forward_decode`` consumes
+it directly) — plus tiny host-side ``pos``/``active`` bookkeeping arrays.
+Admitting a request is a host-side slot assignment followed by an in-place
+``dynamic_update_slice`` of the prefilled slab into the slot row
+(:func:`write_slot`, traced inside the engine's prefill program); retiring
+is flipping a host bit.  Neither ever changes a device shape, so the
+compiled decode step survives any admit/retire sequence — the property the
+whole engine is built on.
+
+Stale-row safety: a freed slot's old K/V rows are NOT zeroed.  They are
+unreachable by construction — a slot's query attends cache rows
+``j <= pos`` only (``ops.attention.slot_cached_attention``), prefill
+overwrites rows ``[0, bucket)``, and each decode step overwrites row
+``pos`` before ``pos`` advances to make it visible — so every visible row
+was written by the request currently owning the slot.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+import jax
+from jax import lax
+
+__all__ = ["SlotKVCache", "write_slot"]
+
+
+def write_slot(kv: Any, slab: Any, slot) -> Any:
+    """Write one request's prefilled cache slab into slot row ``slot``.
+
+    ``kv``: the engine cache — list per layer of ``(k, v)`` with shape
+    (num_slots, max_len, H, D).  ``slab``: ``init_cache(1, bucket)``
+    output run through the model's prefill — list per layer of ``(k, v)``
+    with shape (1, bucket, H, D).  ``slot`` may be traced (it is, inside
+    the jitted prefill program); the write is a pure
+    ``dynamic_update_slice`` per layer — no recompile across slots.
+    """
+    out: List[tuple] = []
+    for (ck, cv), (sk, sv) in zip(kv, slab):
+        out.append(
+            (
+                lax.dynamic_update_slice(
+                    ck, sk.astype(ck.dtype), (slot, 0, 0, 0)
+                ),
+                lax.dynamic_update_slice(
+                    cv, sv.astype(cv.dtype), (slot, 0, 0, 0)
+                ),
+            )
+        )
+    return out
+
+
+class SlotKVCache:
+    """Host bookkeeping around the device cache pytree.
+
+    ``pos[slot]`` is the number of tokens currently cached for the slot
+    (equivalently: the row the slot's NEXT token will be written to);
+    ``active[slot]`` marks slots owned by a running request.  Both live as
+    host numpy — they ride into the compiled programs as tiny dynamic
+    inputs, never as static values.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        num_slots: int,
+        max_len: int,
+        placement: Optional[Any] = None,
+    ):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        # COMMIT the fresh cache to its placement: the engine's programs
+        # return committed arrays, and an uncommitted first-call cache
+        # would flip the jit signature (committed-ness is part of it) on
+        # the second call — one silent recompile per program, the exact
+        # class the two-program discipline exists to prevent.  The
+        # placement must agree with the params' devices (mixed committed
+        # device sets are a jit error), so the engine derives it from the
+        # params (replicated over their mesh when they are sharded).
+        self.kv = jax.device_put(
+            model.init_cache(self.num_slots, self.max_len),
+            placement if placement is not None else jax.devices()[0],
+        )
+        self.pos = np.zeros(self.num_slots, np.int32)
+        self.active = np.zeros(self.num_slots, bool)
+
+    def admit(self, slot: int, true_len: int) -> None:
+        """Claim ``slot`` for a freshly prefilled request of ``true_len``
+        prompt tokens (the engine's prefill program writes the slab)."""
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} is already active")
+        if not 0 < true_len <= self.max_len:
+            raise ValueError(
+                f"prompt length {true_len} outside (0, {self.max_len}]"
+            )
+        self.pos[slot] = true_len
+        self.active[slot] = True
+
+    def advance(self, slots: Optional[np.ndarray] = None) -> None:
+        """One decode step happened: each active (or listed) slot cached
+        one more token."""
+        mask = self.active if slots is None else slots
+        self.pos[mask] += 1
+
+    def retire(self, slot: int) -> None:
+        self.active[slot] = False
+
+    def full(self, slot: int) -> bool:
+        """No room to decode another token into this slot."""
+        return int(self.pos[slot]) >= self.max_len
+
+    def positions(self) -> np.ndarray:
+        """Per-slot write positions for the decode program, clamped into
+        range for inactive slots (their rows are dead weight either way —
+        see the stale-row note in the module docstring)."""
+        return np.clip(self.pos, 0, self.max_len - 1).astype(np.int32)
+
+    @property
+    def active_count(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for pair in self.kv
+            for a in pair
+        )

@@ -1,0 +1,144 @@
+"""Serving metrics: counters, gauges, and histograms with a plain-dict
+snapshot.
+
+Zero-dependency observability for ``serve.engine.ServeEngine`` — the
+serving-side sibling of ``utils.profiling`` (which covers the XLA
+timeline).  Everything here is host-side bookkeeping: recording a value
+never touches the device, so metrics can be sampled every scheduler tick
+without perturbing the two-program dispatch discipline.
+
+``snapshot()`` returns one flat JSON-serializable dict (counters verbatim,
+gauges verbatim, ``<hist>_mean/_p50/_p95/_max/_count`` per histogram, plus
+derived throughput rates) — the record ``scripts/bench_serve.py`` emits as
+its last stdout line.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Histogram", "ServeMetrics"]
+
+
+class Histogram:
+    """Bounded-reservoir histogram of float observations.
+
+    Keeps the most recent ``maxlen`` samples (serving runs are unbounded;
+    all-time exact quantiles are not worth unbounded memory) while count
+    and sum stay exact over the full lifetime.
+    """
+
+    def __init__(self, maxlen: int = 4096):
+        self._maxlen = int(maxlen)
+        self._samples: List[float] = []
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self._samples.append(value)
+        if len(self._samples) > self._maxlen:
+            # drop the oldest half in one slice instead of popping per call
+            self._samples = self._samples[self._maxlen // 2 :]
+
+    def _quantile(self, q: float) -> Optional[float]:
+        if not self._samples:
+            return None
+        xs = sorted(self._samples)
+        idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
+        return xs[idx]
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        return {
+            "count": self.count,
+            "mean": self.total / self.count if self.count else None,
+            "p50": self._quantile(0.50),
+            "p95": self._quantile(0.95),
+            "max": max(self._samples) if self._samples else None,
+        }
+
+
+class ServeMetrics:
+    """The ``ServeEngine`` metric set.
+
+    Counters: ``requests_submitted/admitted/completed/truncated``,
+    ``tokens_prefilled`` (padded-bucket tokens, the compute actually
+    spent), ``tokens_generated`` (every sampled token, the prefill's
+    first token included), ``tokens_decoded`` (decode-step tokens only —
+    the numerator matching ``decode_s`` time), ``prefill_calls``,
+    ``decode_steps``.
+    Gauges: ``queue_depth``, ``active_slots``.
+    Histograms: ``ttft_s`` (submit -> first token on host),
+    ``e2e_latency_s``, ``queue_wait_s``, ``slot_occupancy`` (active /
+    total slots, sampled per decode step), ``prefill_s`` / ``decode_s``
+    (per-dispatch wall times, fetch included).
+    """
+
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self.started_at = time.monotonic()
+        self.counters: Dict[str, int] = {
+            "requests_submitted": 0,
+            "requests_admitted": 0,
+            "requests_completed": 0,
+            "requests_truncated": 0,
+            "tokens_prefilled": 0,
+            "tokens_generated": 0,
+            "tokens_decoded": 0,
+            "prefill_calls": 0,
+            "decode_steps": 0,
+        }
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.ttft_s = Histogram()
+        self.e2e_latency_s = Histogram()
+        self.queue_wait_s = Histogram()
+        self.slot_occupancy = Histogram()
+        self.prefill_s = Histogram()
+        self.decode_s = Histogram()
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def observe_gauges(self, queue_depth: int, active_slots: int) -> None:
+        self.queue_depth = queue_depth
+        self.active_slots = active_slots
+        self.slot_occupancy.record(active_slots / max(1, self.num_slots))
+
+    def snapshot(self) -> dict:
+        """One flat, JSON-serializable dict of everything above plus
+        derived rates (``decode_tokens_per_sec`` over decode-dispatch
+        time — the engine's steady-state throughput — and
+        ``wall_tokens_per_sec`` over the metrics lifetime)."""
+        out: dict = dict(self.counters)
+        out["queue_depth"] = self.queue_depth
+        out["active_slots"] = self.active_slots
+        out["num_slots"] = self.num_slots
+        for name in (
+            "ttft_s",
+            "e2e_latency_s",
+            "queue_wait_s",
+            "slot_occupancy",
+            "prefill_s",
+            "decode_s",
+        ):
+            for k, v in getattr(self, name).snapshot().items():
+                out[f"{name}_{k}"] = v
+        wall = time.monotonic() - self.started_at
+        out["wall_s"] = wall
+        # decode-only tokens over decode-only time: prefill's sampled
+        # token rides a prefill dispatch, so counting it here would
+        # inflate short-generation throughput
+        decode_time = self.decode_s.total
+        out["decode_tokens_per_sec"] = (
+            self.counters["tokens_decoded"] / decode_time
+            if decode_time > 0
+            else None
+        )
+        out["wall_tokens_per_sec"] = (
+            self.counters["tokens_generated"] / wall if wall > 0 else None
+        )
+        return out

@@ -1,0 +1,202 @@
+"""Request queue + slot allocation: the continuous-batching policy.
+
+FCFS with two admission gates: a free cache slot, and a max-tokens budget
+(the sum of ``prompt + max_new_tokens`` over running requests, capping the
+worst-case cache footprint a burst can claim).  New requests prefill into
+freed slots while the other slots keep decoding — admission never stalls
+the running batch, and nothing here touches the device.
+
+Deadlines are wall-clock (``time.monotonic``): an expired request — queued
+or running — finishes immediately with whatever tokens it has, flagged
+``truncated`` with ``finish_reason="deadline"``.  The other terminal
+reasons are ``"stop"`` (EOS), ``"length"`` (``max_new_tokens`` reached),
+and ``"cache_full"`` (slot hit the cache's ``max_len`` — also truncated,
+the request wanted more room than the geometry has).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Request", "RequestHandle", "RequestResult", "Scheduler"]
+
+_TRUNCATED_REASONS = ("deadline", "cache_full")
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal state of one request.  ``tokens`` are the GENERATED ids
+    only (prompt excluded); ``truncated`` means the request ended before
+    its own stopping rule (deadline or cache exhaustion) and ``tokens``
+    is a partial result."""
+
+    rid: int
+    tokens: np.ndarray
+    finish_reason: str
+    truncated: bool
+    ttft_s: Optional[float]
+    latency_s: float
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray  # (S,) int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    deadline_s: Optional[float] = None  # seconds from submit, wall clock
+    # -- lifecycle (owned by the scheduler/engine) -----------------------
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    slot: Optional[int] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+
+    @property
+    def cost(self) -> int:
+        """Tokens this request can occupy at worst — the budget unit."""
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
+    def expired(self, now: float) -> bool:
+        d = self.deadline_at
+        return d is not None and now >= d
+
+    def result(self) -> RequestResult:
+        if self.finish_reason is None:
+            raise RuntimeError(f"request {self.rid} is not finished")
+        return RequestResult(
+            rid=self.rid,
+            tokens=np.asarray(self.generated, np.int32),
+            finish_reason=self.finish_reason,
+            truncated=self.finish_reason in _TRUNCATED_REASONS,
+            ttft_s=(
+                None
+                if self.first_token_at is None
+                else self.first_token_at - self.submitted_at
+            ),
+            latency_s=(self.finished_at or time.monotonic())
+            - self.submitted_at,
+        )
+
+
+class RequestHandle:
+    """The ``submit()`` return value: poll ``done()``, then ``result()``.
+    (``ServeEngine.step()`` drives progress; a handle never blocks.)"""
+
+    def __init__(self, request: Request):
+        self._request = request
+
+    @property
+    def rid(self) -> int:
+        return self._request.rid
+
+    def done(self) -> bool:
+        return self._request.finish_reason is not None
+
+    def result(self) -> RequestResult:
+        return self._request.result()
+
+
+class Scheduler:
+    """FCFS queue + free-slot allocator + in-flight token budget."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        max_tokens_in_flight: Optional[int] = None,
+    ):
+        self.num_slots = int(num_slots)
+        self.max_tokens_in_flight = max_tokens_in_flight
+        self._queue: Deque[Request] = deque()
+        self._free_slots = sorted(range(self.num_slots), reverse=True)
+        self._running: dict[int, Request] = {}  # slot -> request
+        self._in_flight_tokens = 0
+        self._rid = itertools.count()
+
+    # -- queue side ------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        request.rid = next(self._rid)
+        request.submitted_at = time.monotonic()
+        self._queue.append(request)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def running(self) -> List[Request]:
+        return list(self._running.values())
+
+    @property
+    def in_flight_tokens(self) -> int:
+        return self._in_flight_tokens
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._running)
+
+    # -- admission -------------------------------------------------------
+
+    def expire_queued(self, now: float) -> List[Request]:
+        """Pull queued requests past their deadline and finish them as
+        truncated with no tokens.  RUNNING requests' deadlines are the
+        engine's job — retiring those must also release KV-cache
+        bookkeeping, which lives outside the scheduler."""
+        expired = [r for r in self._queue if r.expired(now)]
+        for r in expired:
+            self._queue.remove(r)
+            r.finish_reason = "deadline"
+            r.finished_at = now
+        return expired
+
+    def admit(self, now: float) -> List[Tuple[Request, int]]:
+        """Admit queued requests FCFS while a slot is free and the token
+        budget holds.  Strict FCFS: a blocked head blocks the line (no
+        skip-ahead starvation of big requests).  Returns (request, slot)
+        pairs; the engine prefills each and then confirms with the
+        KV-cache bookkeeping."""
+        admitted = []
+        while self._queue and self._free_slots:
+            head = self._queue[0]
+            if (
+                self.max_tokens_in_flight is not None
+                and self._in_flight_tokens + head.cost
+                > self.max_tokens_in_flight
+                and self._running
+            ):
+                break  # budget holds until running requests retire
+            self._queue.popleft()
+            slot = self._free_slots.pop()
+            head.slot = slot
+            head.admitted_at = now
+            self._running[slot] = head
+            self._in_flight_tokens += head.cost
+            admitted.append((head, slot))
+        return admitted
+
+    def retire(self, request: Request) -> None:
+        """Return a running request's slot to the free pool (the caller
+        sets ``finish_reason``/``finished_at``)."""
+        slot = request.slot
+        if slot is None or self._running.get(slot) is not request:
+            raise ValueError(f"request {request.rid} is not running")
+        del self._running[slot]
+        self._free_slots.append(slot)
+        self._free_slots.sort(reverse=True)
+        self._in_flight_tokens -= request.cost
+        request.slot = None

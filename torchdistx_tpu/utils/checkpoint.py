@@ -33,6 +33,17 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _metadata_tree(ckptr, path: str):
+    """The checkpoint's plain-nest metadata tree, across the orbax API
+    drift: newer orbax wraps it (``metadata(path).item_metadata.tree``),
+    0.7-era orbax returns the tree directly."""
+    meta = ckptr.metadata(path)
+    item = getattr(meta, "item_metadata", None)
+    if item is not None:
+        return item.tree
+    return meta
+
+
 def save_checkpoint(path: str, state: Any) -> None:
     """Save a pytree of arrays (params, optimizer state, counters).
 
@@ -105,7 +116,7 @@ def restore_checkpoint(
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     if shardings_from is not None:
-        meta = ckptr.metadata(path).item_metadata.tree
+        meta = _metadata_tree(ckptr, path)
         restore_args = _restore_args_from_template(meta, shardings_from)
         out = ckptr.restore(path, restore_args=restore_args)
     elif shardings is None:
@@ -113,7 +124,7 @@ def restore_checkpoint(
         # post-validates/casts below
         out = ckptr.restore(path)
     else:
-        meta = ckptr.metadata(path).item_metadata.tree
+        meta = _metadata_tree(ckptr, path)
 
         def spec_for(leaf_meta, sh):
             return (
@@ -255,7 +266,7 @@ def load_module(
     """
     apath = os.path.abspath(path)
     if sharding_rule is not None:
-        meta = _checkpointer().metadata(apath).item_metadata.tree
+        meta = _metadata_tree(_checkpointer(), apath)
         shardings = {k: sharding_rule(k, m) for k, m in meta.items()}
         state = restore_checkpoint(apath, shardings=shardings)
     else:

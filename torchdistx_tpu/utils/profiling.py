@@ -15,6 +15,7 @@ import jax
 __all__ = [
     "trace",
     "annotate",
+    "timed_annotation",
     "device_memory_stats",
     "format_memory_stats",
     "cost_summary",
@@ -34,6 +35,26 @@ def trace(log_dir: str) -> Iterator[None]:
 def annotate(name: str):
     """Named region that shows up on the profiler timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def timed_annotation(name: str, sink: Optional[Any] = None) -> Iterator[dict]:
+    """:func:`annotate` plus wall-clock timing: the region lands on the
+    XLA timeline AND its host-side duration is captured.  Yields a dict
+    that gains ``{"seconds": ...}`` on exit; ``sink(seconds)`` is called
+    if given (e.g. a ``serve.metrics.Histogram.record``).  The serving
+    engine wraps its prefill/decode dispatches with this so a profiler
+    trace and the metrics snapshot describe the same regions.
+    """
+    import time
+
+    out: dict = {}
+    t0 = time.perf_counter()
+    with annotate(name):
+        yield out
+    out["seconds"] = time.perf_counter() - t0
+    if sink is not None:
+        sink(out["seconds"])
 
 
 def cost_summary(fn: Any, *args: Any, peak_flops: Optional[float] = None, **kwargs: Any) -> dict:
