@@ -202,9 +202,12 @@ def _run_kernel_sweep(timeout_s: float) -> dict:
     (scripts/verify_kernels_onchip.py).  Piggybacking on the driver's
     bench run means a relay that is alive at driver time captures
     compiled-kernel evidence (KERNEL_ACCEPT.json) even when it was
-    wedged for the whole builder session.  Same ``_run_phase`` armor; on
-    a timeout/kill, partial per-case records remain in
-    KERNEL_ACCEPT.json (the sweep rewrites it after every phase)."""
+    wedged for the whole builder session.  Same ``_run_phase`` armor.
+    Artifact semantics (see the sweep's docstring): compiled runs write
+    KERNEL_ACCEPT.json, non-TPU/smoke runs divert to
+    KERNEL_ACCEPT_SMOKE.json, and neither file is ever replaced by
+    strictly worse evidence — after a killed partial run the reliable
+    harvest channel is the sweep's stdout (parsed here), not the file."""
     if timeout_s <= 80:  # sweep preflight alone needs ~75 s
         return {"skipped": "deadline exhausted"}
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),

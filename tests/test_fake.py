@@ -158,23 +158,27 @@ class TestCatchAllInterception:
     def test_module_proxy_resolves_rebinding_live(self, monkeypatch):
         # The initializer-globals proxy caches wrappers per underlying
         # object identity, so a later rebinding of the sampler in the
-        # module the proxy stands in for (jax._src.random — the module
-        # initializer closures actually resolve through) must take effect
-        # inside those closures exactly as it does for direct callers.
-        import jax._src.random as internal_random
+        # module the proxy stands in for must take effect inside
+        # initializer closures exactly as it does for direct callers.
+        # The module those closures actually resolve through is a jax
+        # layout detail (public jax.random on 0.4.37, jax._src.random on
+        # newer layouts) — unwrap the installed proxy to find it.
+        import jax._src.nn.initializers as ini_internal
         import jax.nn.initializers as ini
 
         key = jax.random.PRNGKey(0)
         ini.uniform(1.0)(key, (4,))  # populate the proxy cache
 
-        real_uniform = internal_random.uniform
+        proxied = ini_internal.random
+        target = getattr(proxied, "__wrapped_original__", proxied)
+        real_uniform = target.uniform
         calls = []
 
         def stub(key, shape=(), *args, **kwargs):
             calls.append(tuple(shape))
             return real_uniform(key, shape, *args, **kwargs)
 
-        monkeypatch.setattr(internal_random, "uniform", stub)
+        monkeypatch.setattr(target, "uniform", stub)
         out = ini.uniform(1.0)(key, (4,))
         assert calls == [(4,)], "rebound sampler was not resolved live"
         assert isinstance(out, jax.Array)

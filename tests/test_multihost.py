@@ -17,6 +17,7 @@ import textwrap
 from unittest import mock
 
 import jax
+import pytest
 
 from torchdistx_tpu.parallel import multihost
 
@@ -105,11 +106,20 @@ _WORKER = textwrap.dedent(
     devs = jax.devices()  # global view: one CPU device per process
     assert len(devs) == 2, devs
     mesh = Mesh(np.array(devs), ("dp",))
-    arr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("dp")), np.full((1,), float(pid + 1))
-    )
-    out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
-    val = float(np.asarray(out.addressable_data(0)))
+    try:
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), np.full((1,), float(pid + 1))
+        )
+        out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+        val = float(np.asarray(out.addressable_data(0)))
+    except RuntimeError as e:
+        # some jaxlib CPU backends lack cross-process collectives
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); the distributed handshake above still ran un-mocked
+        if "Multiprocess computations" in str(e):
+            print(f"SKIPCOLLECTIVE {pid} {e}", flush=True)
+            sys.exit(0)
+        raise
     assert val == 3.0, val  # 1.0 (proc 0) + 2.0 (proc 1), psum'd
     print(f"OK {pid} {val}", flush=True)
     """
@@ -154,6 +164,13 @@ class TestRealTwoProcess:
                 p.kill()
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        if any("SKIPCOLLECTIVE" in out for out in outs):
+            pytest.skip(
+                "handshake verified (init_multihost + 2-device global "
+                "mesh), but this jaxlib's CPU backend lacks "
+                "cross-process collectives"
+            )
+        for i, out in enumerate(outs):
             assert f"OK {i} 3.0" in out, out
 
 

@@ -133,12 +133,12 @@ def _decode_tokens(
     )
 
 
-def _cached_jit(model, store: str, cache_key, build):
+def _cached_jit(model, store: str, cache_key, build, donate_argnums=()):
     # jit cache lives ON the model so executables (which close over the
     # model) are collected with it rather than pinned by a module global
     builders = model.__dict__.setdefault(store, {})
     if cache_key not in builders:
-        builders[cache_key] = jax.jit(build)
+        builders[cache_key] = jax.jit(build, donate_argnums=donate_argnums)
     return builders[cache_key]
 
 

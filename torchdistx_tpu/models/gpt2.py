@@ -21,7 +21,7 @@ from ..ops.attention import (
     slot_cached_attention,
 )
 from ..ops.flash_attention import resolve_use_flash
-from ..parallel.compat import axis_size
+from ..utils.compat import axis_size
 
 __all__ = ["GPT2Config", "GPT2", "gpt2_configs"]
 

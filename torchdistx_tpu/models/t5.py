@@ -16,7 +16,7 @@ from .. import nn
 from ..nn import functional as F
 from ..ops.attention import cached_attention
 from ..ops.flash_attention import rel_pos_bucket, resolve_use_flash
-from ..parallel.compat import axis_size
+from ..utils.compat import axis_size
 
 __all__ = ["T5Config", "T5", "t5_configs"]
 

@@ -27,8 +27,11 @@ Scope and limitations (documented divergence from a true dispatcher hook):
     and non-jnp entry points (``jax.nn.relu``) escape it — a fake argument
     there surfaces JAX's invalid-type error whose repr shows ``fake=True``;
   - ``jax.random`` key plumbing (``PRNGKey``/``key``/``split``/``fold_in``)
-    is deliberately NOT intercepted — keys stay real so the counter-based
-    RNG stream (utils/rng.py) keeps deferred/eager init bit-identical;
+    is never faked — keys stay real so the counter-based RNG stream
+    (utils/rng.py) keeps deferred/eager init bit-identical.  It IS wrapped,
+    to suspend the mode around the call: this jax's internals resolve the
+    patched public ``jnp``, so an unwrapped ``PRNGKey(0)`` under the mode
+    would have its internal coercions faked (see _RANDOM_KEY_PLUMBING);
   - creation calls inside an active jax trace (jit/grad) are not faked:
     returning a FakeArray into a tracer would corrupt the trace.
 """
@@ -96,8 +99,28 @@ _METADATA_PASSTHROUGH = {
     "printoptions",
 }
 
+# jax.random key plumbing: never faked — keys stay real so the
+# counter-based RNG stream (utils/rng.py) keeps deferred/eager init
+# bit-identical.  On this jax (0.4.37) their INTERNALS resolve the
+# patched public ``jax.numpy`` (jax._src.random does ``import jax.numpy
+# as jnp``), so "not intercepting" them is not enough: a bare
+# ``PRNGKey(0)`` under the mode would have its internal ``jnp.asarray``
+# coercions faked.  They are wrapped to SUSPEND the mode for the
+# duration of the call instead.
+_RANDOM_KEY_PLUMBING = {
+    "PRNGKey",
+    "key",
+    "split",
+    "fold_in",
+    "key_data",
+    "wrap_key_data",
+    "clone",
+    "key_impl",
+}
+
 # jax.random samplers (factory ops keyed by a real PRNG key).
 _RANDOM_CREATION = {
+    "bits",
     "normal",
     "uniform",
     "truncated_normal",
@@ -165,6 +188,26 @@ def _make_wrapper(name: str, orig: Callable[..., Any], creation: bool):
         return orig(*args, **kwargs)
 
     wrapper.__wrapped_original__ = orig  # uninstall marker
+    return wrapper
+
+
+def _make_key_plumbing_wrapper(orig: Callable[..., Any]):
+    """Run a jax.random key-plumbing fn with the fake/deferred mode
+    suspended: its output must be a real key, and its internal jnp
+    coercions must not be faked (see _RANDOM_KEY_PLUMBING)."""
+    from ..fake import in_fake_mode
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if (in_fake_mode() and _trace_clean()
+                and not (_has_fake(args) or _has_fake(kwargs.values()))):
+            from ..fake import no_deferred_init
+
+            with no_deferred_init():
+                return orig(*args, **kwargs)
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped_original__ = orig
     return wrapper
 
 
@@ -298,6 +341,12 @@ class _Patcher:
                 wrapper = _wrap_callable(f"random_{name}", orig, True)
                 self._saved.append((jax.random, name, orig))
                 setattr(jax.random, name, wrapper)
+            for name in _RANDOM_KEY_PLUMBING:
+                orig = getattr(jax.random, name, None)
+                if orig is None or not _wrappable(orig):
+                    continue
+                self._saved.append((jax.random, name, orig))
+                setattr(jax.random, name, _make_key_plumbing_wrapper(orig))
             # jax.nn activations (relu/gelu/softmax/...): two-level coverage.
             # Level 1 — the public namespace, so attribute-style calls
             # (``jax.nn.gelu(fake)``) fake-propagate instead of leaking a
@@ -404,6 +453,10 @@ class _Patcher:
                      _RANDOM_CREATION),
                     ("jnp", getattr(_ini_internal, "jnp", None),
                      _JNP_CREATION),
+                    # orthogonal()'s body also resolves ``lax`` from these
+                    # globals (lax.broadcast_to_rank on the QR sign fix-up)
+                    ("lax", getattr(_ini_internal, "lax", None),
+                     set()),
                 ):
                     if not isinstance(target, types.ModuleType):
                         continue

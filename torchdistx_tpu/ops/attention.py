@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..parallel.compat import axis_size
+from ..utils.compat import axis_size
 
 __all__ = [
     "multihead_attention",

@@ -1,0 +1,48 @@
+"""jax API drift shims.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+top level, its replication-check kwarg was renamed ``check_rep`` ->
+``check_vma``, and ``lax.axis_size`` grew out of ``core.axis_frame`` in
+the same window.  Every call site in this repo (library, tests, examples,
+driver) writes the NEW spelling and imports the wrapper from here (or via
+the ``parallel.compat`` re-export), so the whole codebase tracks one jax
+version boundary in one place.
+
+Lives under ``utils`` so leaf consumers (``ops.attention``, the model
+forwards) can use ``axis_size`` without importing the parallel package —
+``parallel/__init__`` eagerly pulls in fsdp/pp/tp/optax, which is both
+heavyweight for kernel-only imports and a circular-import trap.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:
+    from jax import shard_map as _shard_map
+
+    _LEGACY_KW = False
+except ImportError:  # pre-rename jax: experimental namespace, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY_KW = True
+
+__all__ = ["shard_map", "axis_size"]
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the modern ``check_vma=`` kwarg accepted on
+    older jax (mapped onto ``check_rep=``)."""
+    if _LEGACY_KW and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis) -> int:
+    """``lax.axis_size`` (static size of a named mapped axis), with the
+    pre-0.4.3x fallback where ``core.axis_frame(name)`` returns it."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    from jax import core
+
+    return core.axis_frame(axis)

@@ -43,16 +43,18 @@ def current_seed() -> int:
 def next_rng_key() -> jax.Array:
     """Next key in the stream.  Creating a key is a host-side O(1) op, so it
     is safe (and storage-free in any meaningful sense) under fake mode."""
-    if _state.root is None:
-        # the root must be a REAL key even when the stream is first pulled
-        # inside fake/deferred mode (a fresh process whose first model is
-        # built under deferred_init): the interposed jax.random.PRNGKey
-        # would otherwise fake the seed array and poison every later draw
-        from ..fake import no_deferred_init
+    # keys must stay REAL even when the stream is pulled inside
+    # fake/deferred mode: the interposed jax.random.PRNGKey would fake
+    # the seed array, and fold_in's INTERNALS reach the interposed
+    # public jnp surface too (jax._src.random imports the public
+    # jax.numpy, so its jnp.uint32/jnp.asarray coercions would fake the
+    # counter and poison every later draw)
+    from ..fake import no_deferred_init
 
-        with no_deferred_init():
+    with no_deferred_init():
+        if _state.root is None:
             _state.root = jax.random.PRNGKey(_state.seed)
-    key = jax.random.fold_in(_state.root, _state.counter)
+        key = jax.random.fold_in(_state.root, _state.counter)
     _state.counter += 1
     return key
 
