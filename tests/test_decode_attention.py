@@ -1,0 +1,179 @@
+"""Pallas slot-paged decode attention (ops/decode_attention.py).
+
+Exactness bar (kernel docstring): interpret mode is exact math modulo
+floating-point association — the probabilities match the jnp path's
+``jax.nn.softmax`` op order bitwise; the final P@V contraction reduction
+is associated differently by XLA's batched-einsum emitter than by any
+per-(slot, head) kernel dot, measured <= 2 f32 ulps.  Tests pin that bar
+(atol/rtol ~1 ulp), far tighter than the flash-attention interpret
+tolerance (2e-5), against ``slot_cached_attention``'s jnp path for
+single-block AND multi-block configurations, all GQA widths, and the
+position edges.  Engine-level BIT-identity of fused-vs-sequential decode
+is pinned in tests/test_serve.py (both sides share this kernel).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchdistx_tpu.ops.attention import slot_cached_attention
+from torchdistx_tpu.ops.decode_attention import decode_attention
+
+_ULP = 3e-7  # ~2 f32 ulps at unit scale
+
+
+def _case(rs, b, hq, hkv, d, max_seq, positions, dtype=jnp.float32):
+    q = jnp.asarray(rs.randn(b, 1, hq, d), dtype)
+    k = jnp.asarray(rs.randn(b, 1, hkv, d), dtype)
+    v = jnp.asarray(rs.randn(b, 1, hkv, d), dtype)
+    cache = (
+        jnp.asarray(rs.randn(b, max_seq, hkv, d), dtype),
+        jnp.asarray(rs.randn(b, max_seq, hkv, d), dtype),
+    )
+    return q, k, v, cache, jnp.asarray(positions, jnp.int32)
+
+
+class TestKernelMatchesReference:
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2), (16, 1)])
+    def test_single_block_matches_jnp_path(self, hq, hkv):
+        rs = np.random.RandomState(hq * 10 + hkv)
+        b, d, max_seq = 3, 8, 16
+        q, k, v, cache, pos = _case(
+            rs, b, hq, hkv, d, max_seq, rs.randint(0, max_seq, (b,))
+        )
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out = decode_attention(q, rk, rv, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    @pytest.mark.parametrize("block_k", [8, 16])
+    def test_multi_block_online_softmax_matches(self, block_k):
+        rs = np.random.RandomState(block_k)
+        b, hq, hkv, d, max_seq = 3, 4, 2, 8, 64
+        # positions straddling block edges: first block only, exact edge,
+        # mid-block, last row
+        q, k, v, cache, pos = _case(
+            rs, b, hq, hkv, d, max_seq,
+            [block_k - 1, block_k, max_seq - 1],
+        )
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out = decode_attention(q, rk, rv, pos, block_k=block_k, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    def test_position_zero_and_full_row(self):
+        rs = np.random.RandomState(0)
+        b, hq, hkv, d, max_seq = 2, 4, 2, 8, 32
+        q, k, v, cache, pos = _case(rs, b, hq, hkv, d, max_seq, [0, 31])
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out = decode_attention(q, rk, rv, pos, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    def test_bf16_inputs(self):
+        rs = np.random.RandomState(5)
+        b, hq, hkv, d, max_seq = 2, 4, 2, 8, 16
+        q, k, v, cache, pos = _case(
+            rs, b, hq, hkv, d, max_seq, [3, 12], dtype=jnp.bfloat16
+        )
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out = decode_attention(q, rk, rv, pos, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+class TestRouting:
+    def test_slot_cached_attention_routes_to_kernel(self):
+        """use_flash=True takes the kernel path end to end: identical
+        cache writes, output within the kernel tolerance."""
+        rs = np.random.RandomState(1)
+        q, k, v, cache, pos = _case(rs, 3, 4, 2, 8, 16, [2, 9, 5])
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out, (fk, fv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=True
+        )
+        np.testing.assert_array_equal(np.asarray(fk), np.asarray(rk))
+        np.testing.assert_array_equal(np.asarray(fv), np.asarray(rv))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    def test_windowed_decode_stays_on_jnp_path(self):
+        """The kernel has no sliding-window mode: window= must fall back
+        to the jnp band path bit-for-bit even with use_flash on."""
+        rs = np.random.RandomState(2)
+        q, k, v, cache, pos = _case(rs, 2, 4, 2, 8, 16, [5, 11])
+        ref, _ = slot_cached_attention(
+            q, k, v, cache, pos, window=4, use_flash=False
+        )
+        out, _ = slot_cached_attention(
+            q, k, v, cache, pos, window=4, use_flash=True
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_auto_resolution_off_tpu_is_jnp(self):
+        """resolve_use_flash(None) off-TPU keeps the jnp path: the
+        default engine on the CPU mesh stays on its pinned bit-exact
+        decode."""
+        rs = np.random.RandomState(3)
+        q, k, v, cache, pos = _case(rs, 2, 4, 2, 8, 16, [5, 11])
+        auto, _ = slot_cached_attention(q, k, v, cache, pos)
+        ref, _ = slot_cached_attention(q, k, v, cache, pos, use_flash=False)
+        if jax.devices()[0].platform == "tpu":
+            pytest.skip("auto resolves to the kernel on TPU")
+        np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
+
+    def test_rejects_multi_token(self):
+        rs = np.random.RandomState(4)
+        q = jnp.asarray(rs.randn(2, 2, 4, 8), jnp.float32)
+        ck = jnp.asarray(rs.randn(2, 16, 2, 8), jnp.float32)
+        with pytest.raises(ValueError, match="one token per slot"):
+            decode_attention(q, ck, ck, jnp.zeros((2,), jnp.int32))
+
+    def test_rejects_indivisible_heads(self):
+        rs = np.random.RandomState(4)
+        q = jnp.asarray(rs.randn(2, 1, 3, 8), jnp.float32)
+        ck = jnp.asarray(rs.randn(2, 16, 2, 8), jnp.float32)
+        with pytest.raises(ValueError, match="not a multiple"):
+            decode_attention(q, ck, ck, jnp.zeros((2,), jnp.int32))
+
+
+@pytest.mark.slow
+class TestKernelSweep:
+    """Full grid of (GQA width, geometry, block split, position pattern) —
+    the heavyweight sibling of TestKernelMatchesReference (nightly)."""
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2), (8, 1)])
+    @pytest.mark.parametrize("max_seq,block_k", [(16, 512), (64, 16), (128, 32)])
+    def test_grid(self, hq, hkv, max_seq, block_k):
+        rs = np.random.RandomState(hq + hkv + max_seq + block_k)
+        b, d = 4, 16
+        positions = np.concatenate(
+            [[0, max_seq - 1], rs.randint(0, max_seq, (b - 2,))]
+        )
+        q, k, v, cache, pos = _case(rs, b, hq, hkv, d, max_seq, positions)
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, cache, pos, use_flash=False
+        )
+        out = decode_attention(q, rk, rv, pos, block_k=block_k, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )

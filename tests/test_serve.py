@@ -5,9 +5,17 @@ The load-bearing invariants, pinned on the 8-device CPU mesh:
 - **Exactness**: a greedy request served through the slot cache is
   bit-identical to ``generation.generate`` on that prompt alone — padding,
   slot reuse, and batch-mates change nothing.
+- **Fused decode exactness**: a ``decode_chunk=K`` engine (K decode steps
+  per dispatch in one on-device scan, one host sync per K tokens) emits
+  BIT-identical token streams to the K=1 engine, greedy and sampled,
+  full and partial slot occupancy — and a slot finishing at in-chunk
+  step ``j`` contributes nothing after ``j``: its tokens stop, its KV
+  rows freeze, and ``masked_slot_steps`` accounts exactly the
+  ``K - 1 - j`` wasted slot-steps.
 - **Dispatch discipline**: a full mixed-length continuous-batching run —
   including a late request admitted into a freed (dirty) slot — compiles
-  exactly two programs (one prefill bucket + one decode step).
+  exactly two programs (one prefill bucket + one decode scan per
+  ``decode_chunk`` value).
 - **Deadlines**: expiry returns a partial result flagged ``truncated``.
 """
 
@@ -278,6 +286,152 @@ class TestDeadlines:
         assert r.tokens.size == 0
 
 
+def _run_chunked(model, k_chunk, requests, *, num_slots=3, eos_token=None,
+                 max_len=64, buckets=(16,), **engine_kw):
+    engine = ServeEngine(
+        model, num_slots=num_slots, max_len=max_len,
+        prefill_buckets=buckets, eos_token=eos_token,
+        decode_chunk=k_chunk, **engine_kw,
+    )
+    return engine, engine.run([dict(r) for r in requests])
+
+
+class TestFusedDecode:
+    """decode_chunk=K: K tokens per dispatch and per host sync, streams
+    bit-identical to the K=1 engine.  The fast tests cover K=4 at both
+    occupancies, greedy and sampled; the slow sweep runs the full
+    K x occupancy x sampling grid (same code path, nightly)."""
+
+    def _requests(self, lengths, temperature, n_new=8):
+        return [
+            {"prompt": p, "max_new_tokens": n_new,
+             "temperature": temperature, "seed": i}
+            for i, p in enumerate(_prompts(21, lengths))
+        ]
+
+    def _assert_identical(self, k_chunk, lengths, temperature):
+        model = _llama()
+        reqs = self._requests(lengths, temperature)
+        _, base = _run_chunked(model, 1, reqs)
+        engine, fused = _run_chunked(model, k_chunk, reqs)
+        for a, b in zip(base, fused):
+            assert a.finish_reason == b.finish_reason
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+        return engine
+
+    def test_k4_greedy_full_and_partial_occupancy(self):
+        # full: 5 requests through 3 slots (churn + late admission at
+        # chunk boundaries); partial: 1 request, 2 slots idle
+        engine = self._assert_identical(4, (6, 11, 9, 4, 13), 0.0)
+        snap = engine.metrics.snapshot()
+        assert snap["decode_steps"] == 4 * snap["decode_dispatches"]
+        # one sync per prefill + one per K-step dispatch, NOT per token
+        assert snap["host_syncs"] == (
+            snap["prefill_calls"] + snap["decode_dispatches"]
+        )
+        assert snap["syncs_per_token"] < 0.5  # vs ~1.1 at K=1
+        self._assert_identical(4, (7,), 0.0)
+
+    def test_k4_sampled_full_and_partial_occupancy(self):
+        self._assert_identical(4, (6, 11, 9, 4, 13), 0.9)
+        self._assert_identical(4, (7,), 0.9)
+
+    def test_fused_decode_through_pallas_kernel_path(self):
+        """use_flash=True routes the in-scan attention through the
+        interpret-mode pallas decode kernel on CPU: fused-vs-sequential
+        stays BIT-identical because both engines share the kernel."""
+        tdx.manual_seed(0)
+        model = Llama.from_name(
+            "tiny", n_kv_heads=2, max_seq_len=64, use_flash=True
+        )
+        reqs = self._requests((6, 9), 0.0, n_new=6)
+        _, base = _run_chunked(model, 1, reqs, num_slots=2)
+        _, fused = _run_chunked(model, 4, reqs, num_slots=2)
+        for a, b in zip(base, fused):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_program_count_one_decode_per_k(self):
+        model = _llama()
+        engine, _ = _run_chunked(model, 4, self._requests((6, 9), 0.0))
+        warm = engine.num_compiled_programs()
+        if warm is None:
+            pytest.skip("jit cache introspection unavailable on this jax")
+        assert warm == 2  # one prefill bucket + ONE K=4 decode scan
+        # more traffic never compiles more
+        engine.run([dict(r) for r in self._requests((5, 12, 8), 0.0)])
+        assert engine.num_compiled_programs() == 2
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k_chunk", [1, 4, 8])
+    @pytest.mark.parametrize("lengths", [(6, 11, 9, 4, 13), (7,)])
+    @pytest.mark.parametrize("temperature", [0.0, 0.9])
+    def test_full_grid_bit_identical(self, k_chunk, lengths, temperature):
+        self._assert_identical(k_chunk, lengths, temperature)
+
+
+class TestFinishMasking:
+    """On-device finish mask: a slot finishing at in-chunk step j emits
+    nothing after j, freezes its KV position, and the engine accounts
+    exactly K - 1 - j masked slot-steps."""
+
+    def _eos_case(self, temperature, seed=3):
+        """Pick the 4th generated token as EOS: with the prefill token at
+        index 0, it lands at in-chunk step j = 2 of the first chunk."""
+        model = _llama()
+        prompt = _prompts(31, (6,))[0]
+        base_engine, base = _run_chunked(
+            model, 1,
+            [{"prompt": prompt, "max_new_tokens": 20,
+              "temperature": temperature, "seed": seed}],
+            num_slots=1, buckets=(8,),
+        )
+        stream = base[0].tokens
+        idx = 3
+        eos = int(stream[idx])
+        assert eos not in stream[:idx].tolist()  # finishes exactly there
+        return model, prompt, eos, stream[: idx + 1]
+
+    @pytest.mark.parametrize("temperature", [0.0, 0.8])
+    def test_eos_mid_chunk_masks_remaining_steps(self, temperature):
+        k_chunk = 16
+        model, prompt, eos, expect = self._eos_case(temperature)
+        engine, results = _run_chunked(
+            model, k_chunk,
+            [{"prompt": prompt, "max_new_tokens": 20,
+              "temperature": temperature, "seed": 3}],
+            num_slots=1, eos_token=eos, buckets=(8,),
+        )
+        r = results[0]
+        assert r.finish_reason == "stop"
+        np.testing.assert_array_equal(r.tokens, expect)  # nothing after j
+        # EOS emitted at in-chunk step j = 2 -> K - 1 - j wasted
+        assert engine.metrics.counters["masked_slot_steps"] == k_chunk - 3
+        # the slot's write position froze where the host stopped: 3
+        # decode steps consumed (the prefill token rode the prefill
+        # dispatch; the EOS token was sampled at step j=2), not K
+        frozen = prompt.size + len(expect) - 1
+        assert int(engine.cache.pos[0]) == frozen
+        # and the device never advanced past it: the masked steps rewrite
+        # the frozen row only, so every row past it stayed virgin zeros —
+        # an unmasked scan would have written rows up to prompt + K
+        k0 = np.asarray(engine.cache.kv[0][0])  # layer 0 K, slot 0 rows
+        assert np.all(k0[0, frozen + 1:] == 0)
+
+    def test_masked_steps_zero_when_chunk_fits(self):
+        """Requests whose remaining budget is a multiple of K finish at
+        the last chunk step: no waste."""
+        model = _llama()
+        engine, results = _run_chunked(
+            model, 4,
+            [{"prompt": _prompts(32, (6,))[0], "max_new_tokens": 9}],
+            num_slots=1,
+        )
+        # 1 prefill token + 8 decode tokens = two full K=4 chunks
+        assert results[0].finish_reason == "length"
+        assert engine.metrics.counters["masked_slot_steps"] == 0
+        assert engine.metrics.counters["decode_dispatches"] == 2
+
+
 class TestSchedulerUnit:
     def _req(self, n=4, **kw):
         return Request(
@@ -321,7 +475,7 @@ class TestKVCacheUnit:
         assert cache.active_count == 1 and cache.pos[0] == 5
         with pytest.raises(ValueError, match="already active"):
             cache.admit(0, 3)
-        cache.advance()
+        cache.advance_slot(0)
         assert cache.pos[0] == 6 and cache.pos[1] == 0
         cache.retire(0)
         assert cache.active_count == 0
@@ -387,6 +541,30 @@ class TestValidation:
             ServeEngine(_llama(), max_len=1024)
         with pytest.raises(ValueError, match="top_k"):
             ServeEngine(_llama(), max_len=32, top_k=0)
+        with pytest.raises(ValueError, match="decode_chunk"):
+            ServeEngine(_llama(), max_len=32, decode_chunk=0)
+
+    def test_prompt_beyond_largest_bucket_raises_at_submit(self):
+        """Regression: explicit prefill_buckets are taken as given (no
+        silent max_len bucket appended), so a prompt longer than the
+        largest bucket must die with a clear ValueError in submit(),
+        never inside the prefill jit."""
+        engine = ServeEngine(
+            _llama(), num_slots=1, max_len=64, prefill_buckets=(8, 16)
+        )
+        assert engine.prefill_buckets == (8, 16)  # nothing appended
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            engine.submit(np.zeros(20, np.int32), max_new_tokens=4)
+        # up to the largest bucket still serves fine
+        r = engine.run(
+            [{"prompt": _prompts(40, (16,))[0], "max_new_tokens": 3}]
+        )[0]
+        assert r.finish_reason == "length"
+
+    def test_prompt_beyond_room_for_max_new_raises_at_submit(self):
+        engine = ServeEngine(_llama(), num_slots=1, max_len=32)
+        with pytest.raises(ValueError, match="at most 12 tokens"):
+            engine.submit(np.zeros(13, np.int32), max_new_tokens=20)
 
 
 class TestMetricsUnit:

@@ -103,6 +103,79 @@ def _make_slot_sampler(
     return sample
 
 
+def _make_fused_decode(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+    decode_chunk: int,
+):
+    """Build the serve engine's fused K-step decode program body: a
+    ``lax.scan`` of ``decode_chunk`` single-token ``forward_decode`` +
+    slot-sampler iterations carrying the (donated) KV slab, per-slot
+    positions, last tokens, sampler step counters, and an on-device
+    *finished* mask — so the engine crosses the host boundary once per
+    ``K x num_slots`` tokens instead of once per token.
+
+    The sampler is ``_make_slot_sampler``'s: each emitted token draws
+    from ``fold_in(PRNGKey(seeds[b]), steps[b])``, the same
+    root-key-plus-monotone-counter discipline as ``utils/rng.py``'s init
+    stream, so a request's sampled tokens depend only on (seed, token
+    index) — never on which scan step, chunk, or slot produced them.
+    Fusing K steps therefore changes no sampled value.
+
+    Finish masking: a slot finishes when it samples ``eos_token``, its
+    sampled count reaches ``budgets[b]`` (the request's
+    ``max_new_tokens``), or its write position hits the cache end —
+    exactly the host-side ``ServeEngine._check_finished`` rules, applied
+    on-device so later scan steps freeze the slot (token, position, and
+    step counter held; its KV rows never advance) instead of decoding
+    garbage into it.  Rows are independent, so frozen slots cannot
+    perturb live ones; the host re-derives per-request finish reasons by
+    walking the emitted ``(K, B)`` block with the same rules.  A frozen
+    slot keeps rewriting its own frozen row — bit-identical to what K
+    separate one-step dispatches do to a retired slot's row, which is
+    what makes fused-vs-sequential cache states comparable.
+
+    Returns ``run(params, kv, toks, positions, temps, seeds, steps,
+    budgets, finished) -> (kv, (K, B) token block)``.
+    """
+
+    def run(params, kv, toks, positions, temps, seeds, steps, budgets,
+            finished):
+        def body(carry, _):
+            kv, tok, pos, stp, fin = carry
+            logits, kv = functional_call(
+                model, params, (tok[:, None], kv, pos),
+                method="forward_decode",
+            )
+            sampled = sampler(logits[:, -1, :], temps, seeds, stp)
+            new_tok = jnp.where(fin, tok, sampled)
+            new_stp = jnp.where(fin, stp, stp + 1)
+            hit_eos = (
+                sampled == eos_token
+                if eos_token is not None
+                else jnp.zeros_like(fin)
+            )
+            hit_len = new_stp >= budgets
+            hit_full = pos + 1 >= max_len  # host's cache_full, pre-clamp
+            new_fin = fin | hit_eos | hit_len | hit_full
+            # the finishing step still advances (the host advances before
+            # it checks), then the position freezes, clamped exactly like
+            # SlotKVCache.positions() clamps a retired slot's
+            new_pos = jnp.where(fin, pos, jnp.clip(pos + 1, 0, max_len - 1))
+            return (kv, new_tok, new_pos, new_stp, new_fin), new_tok
+
+        (kv, _, _, _, _), toks_block = jax.lax.scan(
+            body, (kv, toks, positions, steps, finished), None,
+            length=decode_chunk,
+        )
+        return kv, toks_block
+
+    return run
+
+
 def _decode_tokens(
     apply_step: Callable[[jax.Array, Any, Any], tuple],
     sample,

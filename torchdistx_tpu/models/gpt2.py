@@ -137,7 +137,9 @@ class GPT2Block(nn.Module):
         h = self.ln1(x)
         qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        a, cache = slot_cached_attention(q, k, v, cache, positions)
+        a, cache = slot_cached_attention(
+            q, k, v, cache, positions, use_flash=self.use_flash
+        )
         x = x + self.attn_out(a.reshape(b, s, d))
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache

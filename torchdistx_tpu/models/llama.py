@@ -266,7 +266,8 @@ class LlamaAttention(nn.Module):
         q = apply_rope_at(q, rope, positions)
         k = apply_rope_at(k, rope, positions)
         out, cache = slot_cached_attention(
-            q, k, v, cache, positions, window=cfg.sliding_window
+            q, k, v, cache, positions, window=cfg.sliding_window,
+            use_flash=cfg.use_flash,
         )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 

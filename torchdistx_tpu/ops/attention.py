@@ -172,6 +172,7 @@ def slot_cached_attention(
     *,
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    use_flash: Optional[bool] = None,
 ):
     """Single-token batched decode where each batch row sits at its OWN
     cache depth — the continuous-batching sibling of
@@ -189,6 +190,17 @@ def slot_cached_attention(
     bit-identical to single-request decode at the same position.
     GQA-aware; ``window`` applies the same end-aligned sliding band as
     the scalar path.  Returns (out, (ck, cv)).
+
+    **Flash decode**: when ``use_flash`` resolves on
+    (``resolve_use_flash``: auto = TPU) and no ``window`` is set, the
+    post-write attend routes through the pallas slot-paged kernel
+    (``ops.decode_attention``): per-slot length-masked blocks streamed
+    off the slab, no ``_repeat_kv`` copy, no (B, H, max_seq) logits
+    band — the hot op of the serve engine's fused decode loop.  The
+    write itself (vmap'd ``dynamic_update_slice``) is identical on both
+    paths, and the kernel's single-K-block configuration is
+    bit-identical to the jnp path in interpret mode
+    (``ops/decode_attention.py`` docstring); windowed decode stays jnp.
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -203,6 +215,13 @@ def slot_cached_attention(
     )
     ck = jax.vmap(write)(ck, k_new, positions)
     cv = jax.vmap(write)(cv, v_new, positions)
+    from .flash_attention import resolve_use_flash
+
+    if window is None and resolve_use_flash(use_flash):
+        from .decode_attention import decode_attention
+
+        out = decode_attention(q, ck, cv, positions, scale=scale)
+        return out, (ck, cv)
     max_seq, hkv = ck.shape[1], ck.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # GQA broadcast mirrors the scalar path's _repeat_kv + einsum exactly.

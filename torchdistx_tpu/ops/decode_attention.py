@@ -1,0 +1,230 @@
+"""Pallas slot-paged decode attention for the serving engine.
+
+One generated token per serving *slot*, each slot at its own cache depth:
+the hot inner op of ``ServeEngine``'s fused decode loop.  The jnp
+reference (``ops.attention.slot_cached_attention``) materializes the full
+``(B, H, 1, max_len)`` f32 logits band and a ``_repeat_kv`` copy of the
+whole slab every step; this kernel streams per-slot length-masked K/V
+blocks straight off the ``(num_slots, max_len, Hkv, D)`` slab with an
+online-softmax accumulator — flash-decode, the single-query sibling of
+``ops/flash_attention.py``.
+
+Layout and masking:
+
+- The slab is consumed IN ITS NATIVE LAYOUT ``(B, max_len, Hkv, D)`` —
+  no transpose of the multi-hundred-MB cache per decode step.  Grid is
+  ``(B, Hkv, n_k)`` with K/V blocks ``(block_k, D)`` sliced per
+  (slot, kv head); the trailing ``(1, D)``-tiled head slice is the price
+  of the native layout and is irrelevant next to not copying the slab.
+- GQA is folded in: the ``n_rep = Hq // Hkv`` query heads of one KV
+  group ride as the ROWS of each matmul (padded up to the f32 sublane
+  minimum of 8), so no repeated K/V ever materializes — the kernel
+  analogue of ``_repeat_kv``.
+- Per-slot lengths arrive as scalar-prefetched ``positions``: block
+  ``kk`` is skipped entirely when ``kk * block_k > positions[b]``
+  (block-level pruning — compute scales with the slot's actual depth,
+  not ``max_len``), the K/V index map clamps pruned blocks onto the last
+  visible one so their DMAs are no-ops, and the diagonal block applies
+  the ``j <= positions[b]`` mask elementwise.
+
+Exactness contract (pinned in tests/test_decode_attention.py): when the
+whole row fits one K block (``max_len <= block_k``, the common serving
+geometry) the kernel computes mask -> rowmax -> exp -> sum -> divide ->
+dot in exactly ``jax.nn.softmax``'s op order, so the interpret-mode
+PROBABILITIES are bit-identical to ``slot_cached_attention``'s jnp path;
+the one remaining divergence is the final P@V contraction, whose
+reduction XLA's CPU emitter associates differently for the batched
+einsum than for any per-(slot, kv-head) dot a blocked kernel can issue —
+measured <= 2 f32 ulps, and pinned at that tolerance (the same
+exact-math-modulo-association bar ``flash_attention``'s interpret tests
+use).  Across multiple K blocks the online-softmax merge additionally
+defers normalization (divide after the accumulated dot), the standard
+flash trade.  ENGINE-level exactness is stronger: fused K-step decode
+vs K one-step dispatches is bit-identical because both route through
+this same kernel (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _CompilerParams, _shrink_block
+
+__all__ = ["decode_attention"]
+
+_NEG_INF = -1e30
+_MIN_ROWS = 8  # f32 sublane minimum: GQA group rows pad up to this
+
+
+def _decode_kernel(
+    pos_ref,  # scalar prefetch: (B,) int32 per-slot visible depth
+    q_ref,  # (rows, D) this slot's query heads for one KV group
+    k_ref,  # (block_k, D)
+    v_ref,  # (block_k, D)
+    o_ref,  # (rows, D)
+    acc_ref,  # VMEM scratch (rows, D) f32
+    m_ref,  # VMEM scratch (rows, 1) f32
+    l_ref,  # VMEM scratch (rows, 1) f32
+    *,
+    scale: float,
+    block_k: int,
+    n_k: int,
+):
+    b = pl.program_id(0)
+    kk = pl.program_id(2)
+    pos = pos_ref[b]
+
+    def tile(mask_value):
+        """Masked (rows, block_k) f32 logits for this K block."""
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        cols = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        return jnp.where(cols <= pos, logits, mask_value)
+
+    if n_k == 1:
+        # Single-block fast path in the jnp reference's exact op order
+        # (mask, rowmax, exp, sum, divide, dot) — bit-identical to
+        # slot_cached_attention's softmax in interpret mode.  No scratch
+        # state: the whole visible row is here.
+        logits = tile(_NEG_INF)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        unnorm = jnp.exp(logits - m)
+        probs = unnorm / jnp.sum(unnorm, axis=-1, keepdims=True)
+        o_ref[...] = jax.lax.dot_general(
+            probs, v_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+        return
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # block-level pruning: blocks entirely past the slot's depth are
+    # skipped (their DMA is also clamped away by the index map)
+    @pl.when(kk * block_k <= pos)
+    def _compute():
+        logits = tile(_NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * correction + jnp.sum(
+            p, axis=-1, keepdims=True
+        )
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p, v_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        # column 0 is always visible (pos >= 0), so l > 0; the guard only
+        # covers pathological all-underflow rows, matching _kernel
+        o_ref[...] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Slot-paged single-token decode attention (post-write).
+
+    ``q``: (B, 1, Hq, D) — each slot's next-token query, positional
+    encoding already applied.  ``ck``/``cv``: the engine slab
+    (B, max_len, Hkv, D) with the new K/V already written at each slot's
+    row (``slot_cached_attention`` performs the write; this kernel only
+    attends).  ``positions``: (B,) int32 — slot ``b`` attends cache rows
+    ``j <= positions[b]``.  Returns (B, 1, Hq, D) in ``q.dtype``.
+
+    ``block_k`` is an upper bound (halved until it divides ``max_len``);
+    when one block covers ``max_len`` the interpret-mode result is
+    bit-identical to the jnp reference (module docstring).  ``interpret``
+    defaults to True off-TPU, per the repo kernel convention.
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode_attention takes one token per slot, got S={s}")
+    max_len, hkv = ck.shape[1], ck.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    n_rep = hq // hkv
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_k = _shrink_block(block_k, max_len)
+    n_k = max_len // block_k
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    # GQA group rows, padded to a sublane multiple: (B, Hkv, rows, D)
+    rows = -(-n_rep // _MIN_ROWS) * _MIN_ROWS
+    qg = q.reshape(b, hkv, n_rep, d)
+    if rows != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep), (0, 0)))
+    positions = positions.astype(jnp.int32)
+
+    def kv_index(bb, h, kk, pos_ref):
+        # clamp blocks past the slot's depth onto its last visible block:
+        # Pallas skips the DMA when the mapped block index is unchanged,
+        # so pruned grid steps move no bytes
+        return (bb, jnp.minimum(kk, pos_ref[bb] // block_k), h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+            ),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale_, block_k=block_k, n_k=n_k
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(positions, qg, ck, cv)
+    return out[:, :, :n_rep, :].reshape(b, 1, hq, d)

@@ -11,20 +11,29 @@ while ALL device work flows through exactly two jitted programs:
    first token from the last REAL prompt position, and
    ``dynamic_update_slice`` the prefilled slab into the request's slot row
    of the engine cache (``kv_cache.write_slot``).
-2. **Decode** (one, ever): one fused batched step over ALL slots — each
-   row at its own cache depth (``forward_decode`` /
-   ``ops.attention.slot_cached_attention``), per-slot temperature (a
-   dynamic input: any greedy/sampling mix shares the program), one sample
-   per slot.
+2. **Decode** (one per ``decode_chunk`` value — default a single one): a
+   ``lax.scan`` of ``K = decode_chunk`` fused batched steps over ALL
+   slots — each row at its own cache depth (``forward_decode`` /
+   ``ops.attention.slot_cached_attention``, which routes to the pallas
+   slot-paged kernel on TPU), per-slot temperature (a dynamic input: any
+   greedy/sampling mix shares the program), carrying the donated KV slab
+   and an on-device finished mask (``generation._make_fused_decode``).
+   One dispatch and ONE host sync emit ``K x num_slots`` tokens; with
+   the default ``decode_chunk=1`` this is exactly the classic
+   one-token-per-sync decode step.
 
 Admitting or retiring a request changes only tiny dynamic inputs
-(positions, temperatures, a slot index), never a compiled shape — the jit
-cache stays at two programs (plus one per extra bucket actually used) no
-matter how traffic churns.  Keeping the per-token dispatch count at ONE
-program is the same relay-dominated-dispatch constraint that motivated
-chunked replay (CLAUDE.md); a greedy slot's token stream is bit-identical
-to ``generation.generate`` on that prompt alone (pinned in
-tests/test_serve.py).
+(positions, temperatures, budgets, a slot index), never a compiled
+shape — the jit cache stays at two programs (plus one per extra bucket
+actually used) no matter how traffic churns.  With ``decode_chunk > 1``
+admission happens only at chunk boundaries: a slot freed at in-chunk
+step ``j`` idles for the remaining ``K - 1 - j`` slot-steps (masked
+on-device, surfaced as the ``masked_slot_steps`` counter) and is refilled
+on the next ``step()``.  Cutting host syncs per token by ~K is the same
+relay-dominated-dispatch constraint that motivated chunked replay
+(CLAUDE.md); a greedy slot's token stream is bit-identical to
+``generation.generate`` on that prompt alone, for every ``decode_chunk``
+(pinned in tests/test_serve.py).
 
 Sampling (``generation._make_slot_sampler``) reuses ``generate``'s
 top-k/top-p filters; the two jitted programs live in the model's
@@ -42,7 +51,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..generation import _cached_jit, _check_sampling_args, _make_slot_sampler
+from ..generation import (
+    _cached_jit,
+    _check_sampling_args,
+    _make_fused_decode,
+    _make_slot_sampler,
+)
 from ..nn.module import functional_call
 from ..utils.profiling import timed_annotation
 from .kv_cache import SlotKVCache, write_slot
@@ -96,9 +110,19 @@ class ServeEngine:
         0 = greedy.
       prefill_buckets: padded prompt lengths; each bucket actually used
         compiles one prefill program.  Default: powers of two up to
-        ``max_len``.
+        ``max_len``.  Explicit buckets are taken AS GIVEN — the largest
+        one caps the admissible prompt length (``submit`` raises past
+        it); no ``max_len`` bucket is appended behind the caller's back.
       max_tokens_in_flight: admission budget over running requests'
         ``prompt + max_new_tokens`` (default: unbounded).
+      decode_chunk: decode steps fused per dispatch (``K``).  Each
+        ``step()`` emits up to ``K`` tokens per running slot with ONE
+        host sync; requests finishing at in-chunk step ``j`` waste
+        ``K - 1 - j`` masked slot-steps and free their slot at the chunk
+        boundary.  Raise it when dispatch latency dominates decode (the
+        relay-dominated regime — see docs/serving.md for choosing K);
+        the default 1 is the classic one-sync-per-token step.  Each
+        distinct value compiles one decode program.
       params: parameter dict override (e.g. sharded params); default
         ``dict(model.named_parameters())``.
     """
@@ -114,6 +138,7 @@ class ServeEngine:
         top_p: Optional[float] = None,
         prefill_buckets: Optional[Sequence[int]] = None,
         max_tokens_in_flight: Optional[int] = None,
+        decode_chunk: int = 1,
         params: Optional[dict] = None,
     ):
         _check_sampling_args(top_k, top_p)
@@ -141,6 +166,9 @@ class ServeEngine:
         self.eos_token = eos_token
         self.top_k = top_k
         self.top_p = top_p
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.decode_chunk = int(decode_chunk)
         if prefill_buckets is None:
             buckets = _default_buckets(self.max_len)
         else:
@@ -151,8 +179,10 @@ class ServeEngine:
                 raise ValueError(
                     f"bucket {buckets[-1]} exceeds max_len {self.max_len}"
                 )
-            if buckets[-1] < self.max_len:
-                buckets = buckets + (self.max_len,)
+            # explicit buckets are respected as given: the largest one is
+            # the prompt-length ceiling submit() enforces.  (Silently
+            # appending a max_len bucket used to hide that ceiling AND
+            # compile a program the caller never asked for.)
         self.prefill_buckets = buckets
         self.cache = SlotKVCache(
             model,
@@ -167,6 +197,7 @@ class ServeEngine:
         self._temps = np.zeros(self.num_slots, np.float32)
         self._seeds = np.zeros(self.num_slots, np.int32)
         self._ntok = np.zeros(self.num_slots, np.int32)  # tokens sampled
+        self._budget = np.zeros(self.num_slots, np.int32)  # max_new_tokens
 
     # -- public API ------------------------------------------------------
 
@@ -192,7 +223,18 @@ class ServeEngine:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the slot cache length "
-                f"{self.max_len}"
+                f"{self.max_len} — the prompt may be at most "
+                f"{self.max_len - max_new_tokens} tokens for this budget"
+            )
+        if prompt.size > self.prefill_buckets[-1]:
+            # fail HERE, not inside the prefill jit: with explicit
+            # prefill_buckets the largest bucket is the longest prompt the
+            # compiled prefill programs can take
+            raise ValueError(
+                f"prompt ({prompt.size}) exceeds the largest prefill "
+                f"bucket ({self.prefill_buckets[-1]}) — pass a larger "
+                "bucket in prefill_buckets (up to max_len "
+                f"{self.max_len}) or shorten the prompt"
             )
         req = Request(
             rid=-1,
@@ -212,8 +254,11 @@ class ServeEngine:
     def step(self) -> int:
         """One scheduler tick: expire deadlines, admit new requests into
         free slots (one prefill dispatch each), then run ONE fused decode
-        step over every slot.  Returns the number of unfinished requests
-        (queued + running)."""
+        dispatch — ``decode_chunk`` on-device steps — over every slot.
+        Admission therefore lands exactly at chunk boundaries, and
+        running-request deadlines are checked once per chunk (a deadline
+        can overshoot by at most one chunk's wall time).  Returns the
+        number of unfinished requests (queued + running)."""
         now = time.monotonic()
         for req in self.scheduler.expire_queued(now):
             self._count_finish(req)
@@ -249,9 +294,11 @@ class ServeEngine:
     def num_compiled_programs(self) -> Optional[int]:
         """Compiled executables behind THIS engine's serving programs —
         the dispatch-discipline invariant tests pin (one prefill per
-        bucket used + one decode).  Other engines on the same model (the
-        jit store lives on the model) have different static keys and are
-        excluded.  On the CPU mesh this equals the program count; on
+        bucket used + one decode per ``decode_chunk`` value used).  Other
+        engines on the same model (the jit store lives on the model) are
+        excluded when their static keys differ; engines sharing
+        ``(num_slots, max_len, top_k, top_p)`` but not ``decode_chunk``
+        share the count, one decode program each.  On the CPU mesh this equals the program count; on
         donation-capable backends each program may carry a second
         executable from the one-time donated-carry layout recompile
         (CLAUDE.md) — the invariant is that the count is STABLE after
@@ -310,18 +357,23 @@ class ServeEngine:
         )
 
     def _decode_program(self):
-        model, sampler = self.model, self._sampler
-
-        def build(params, kv, toks, positions, temps, seeds, steps):
-            logits, kv = functional_call(
-                model, params, (toks, kv, positions), method="forward_decode"
-            )
-            return kv, sampler(logits[:, -1, :], temps, seeds, steps)
-
+        """The fused K-step decode program (``_make_fused_decode``): one
+        per ``(decode_chunk, eos_token)`` — both are baked into the scan
+        body (the on-device finish mask needs the EOS id; the scan length
+        is the chunk).  The default single-K engine therefore still holds
+        the one-decode-program invariant."""
+        build = _make_fused_decode(
+            self.model,
+            self._sampler,
+            eos_token=self.eos_token,
+            max_len=self.max_len,
+            decode_chunk=self.decode_chunk,
+        )
         return _cached_jit(
-            model,
+            self.model,
             "_serve_jit_cache",
-            ("serve_decode",) + self._static_key(),
+            ("serve_decode", self.decode_chunk, self.eos_token)
+            + self._static_key(),
             build,
             donate_argnums=(1,),  # kv slab: same aliasing as prefill
         )
@@ -332,8 +384,12 @@ class ServeEngine:
         for b in self.prefill_buckets:
             if b >= length:
                 return b
-        raise ValueError(  # unreachable: submit bounds prompt < max_len
-            f"prompt length {length} exceeds the largest bucket"
+        # submit() pre-validates against prefill_buckets[-1], so reaching
+        # here means a caller bypassed it — same clear error either way,
+        # raised host-side, never from inside the prefill jit
+        raise ValueError(
+            f"prompt length {length} exceeds the largest prefill bucket "
+            f"({self.prefill_buckets[-1]})"
         )
 
     def _prefill_request(self, req: Request, slot: int) -> None:
@@ -361,9 +417,11 @@ class ServeEngine:
         self._temps[slot] = req.temperature
         self._seeds[slot] = req.seed
         self._ntok[slot] = 1
+        self._budget[slot] = req.max_new_tokens
         now = time.monotonic()
         req.first_token_at = now
         req.generated.append(tok)
+        self.metrics.count("host_syncs")
         self.metrics.count("prefill_calls")
         self.metrics.count("requests_admitted")
         self.metrics.count("tokens_prefilled", bucket)
@@ -373,31 +431,57 @@ class ServeEngine:
         self._check_finished(req, tok, now)
 
     def _decode_step(self) -> None:
+        """One fused decode dispatch: ``K = decode_chunk`` on-device
+        steps, ONE host sync for the whole ``(K, num_slots)`` token
+        block.  The host then walks each running request's column with
+        the same finish rules the device mask applied
+        (``_check_finished``), so the host's bookkeeping (positions,
+        token counts, finish reasons, metrics) and the device's frozen
+        carries agree step for step; tokens a request emitted after its
+        own finish never exist on the host side, and the slot-steps the
+        device masked out are accounted in ``masked_slot_steps``."""
         running = self.scheduler.running
+        k_steps = self.decode_chunk
         program = self._decode_program()
-        with timed_annotation("serve/decode", self.metrics.decode_s.record):
-            kv, out = program(
+        with timed_annotation(
+            "serve/decode", self.metrics.decode_s.record
+        ) as timing:
+            kv, block = program(
                 self.params,
                 self.cache.kv,
-                jnp.asarray(self._last_tok[:, None]),
+                jnp.asarray(self._last_tok),
                 jnp.asarray(self.cache.positions()),
                 jnp.asarray(self._temps),
                 jnp.asarray(self._seeds),
                 jnp.asarray(self._ntok),
+                jnp.asarray(self._budget),
+                jnp.asarray(~self.cache.active),  # retired slots: finished
             )
             self.cache.kv = kv  # before the sync: old slab was donated
-            out = np.asarray(out)
-        self._ntok[self.cache.active] += 1
-        self.cache.advance()  # every running slot cached one more token
-        self.metrics.count("decode_steps")
-        self.metrics.count("tokens_generated", len(running))
-        self.metrics.count("tokens_decoded", len(running))
+            block = np.asarray(block)  # ONE host sync per K slot-steps
+        self.metrics.count("host_syncs")
+        self.metrics.count("decode_dispatches")
+        self.metrics.count("decode_steps", k_steps)
         now = time.monotonic()
+        emitted = 0
         for req in running:
-            tok = int(out[req.slot])
-            self._last_tok[req.slot] = tok
-            req.generated.append(tok)
-            self._check_finished(req, tok, now)
+            slot = req.slot
+            for j in range(k_steps):
+                tok = int(block[j, slot])
+                self._ntok[slot] += 1
+                self.cache.advance_slot(slot)
+                self._last_tok[slot] = tok
+                req.generated.append(tok)
+                emitted += 1
+                if self._check_finished(req, tok, now):
+                    # the device froze this slot for the rest of the
+                    # chunk; those slot-steps bought nothing
+                    self.metrics.count("masked_slot_steps", k_steps - 1 - j)
+                    break
+        self.metrics.count("tokens_generated", emitted)
+        self.metrics.count("tokens_decoded", emitted)
+        if emitted:
+            self.metrics.decode_token_s.record(timing["seconds"] / emitted)
 
     def _check_finished(self, req: Request, tok: int, now: float) -> bool:
         if self.eos_token is not None and tok == self.eos_token:

@@ -106,11 +106,12 @@ class SlotKVCache:
         self.pos[slot] = true_len
         self.active[slot] = True
 
-    def advance(self, slots: Optional[np.ndarray] = None) -> None:
-        """One decode step happened: each active (or listed) slot cached
-        one more token."""
-        mask = self.active if slots is None else slots
-        self.pos[mask] += 1
+    def advance_slot(self, slot: int) -> None:
+        """One slot cached one more token.  Advancement is per-slot (not
+        an all-active-slots sweep) because the engine's fused-chunk walk
+        consumes a different number of the chunk's K steps per request —
+        a finished slot must stay exactly where the device froze it."""
+        self.pos[slot] += 1
 
     def retire(self, slot: int) -> None:
         self.active[slot] = False

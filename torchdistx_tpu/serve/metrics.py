@@ -67,14 +67,24 @@ class ServeMetrics:
     Counters: ``requests_submitted/admitted/completed/truncated``,
     ``tokens_prefilled`` (padded-bucket tokens, the compute actually
     spent), ``tokens_generated`` (every sampled token, the prefill's
-    first token included), ``tokens_decoded`` (decode-step tokens only —
-    the numerator matching ``decode_s`` time), ``prefill_calls``,
-    ``decode_steps``.
+    first token included), ``tokens_decoded`` (decode-dispatch tokens
+    only — the numerator matching ``decode_s`` time), ``prefill_calls``,
+    ``decode_steps`` (on-device decode iterations: ``decode_chunk`` per
+    dispatch), ``decode_dispatches`` (compiled-program launches),
+    ``host_syncs`` (device->host materializations: one per prefill and
+    one per decode dispatch — with ``decode_chunk=K`` roughly 1/K per
+    token, THE number the fused decode loop exists to shrink), and
+    ``masked_slot_steps`` (slot-steps the on-device finish mask threw
+    away because a request finished mid-chunk: the wasted-work side of
+    the host-sync tradeoff).
     Gauges: ``queue_depth``, ``active_slots``.
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``slot_occupancy`` (active /
-    total slots, sampled per decode step), ``prefill_s`` / ``decode_s``
-    (per-dispatch wall times, fetch included).
+    total slots, sampled per decode dispatch), ``prefill_s`` /
+    ``decode_s`` (per-dispatch wall times, fetch included), and
+    ``decode_token_s`` (decode dispatch wall time / tokens it emitted —
+    the per-token latency a consumer actually experiences, amortized
+    over the chunk).
     """
 
     def __init__(self, num_slots: int):
@@ -90,6 +100,9 @@ class ServeMetrics:
             "tokens_decoded": 0,
             "prefill_calls": 0,
             "decode_steps": 0,
+            "decode_dispatches": 0,
+            "host_syncs": 0,
+            "masked_slot_steps": 0,
         }
         self.queue_depth = 0
         self.active_slots = 0
@@ -99,6 +112,7 @@ class ServeMetrics:
         self.slot_occupancy = Histogram()
         self.prefill_s = Histogram()
         self.decode_s = Histogram()
+        self.decode_token_s = Histogram()
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
@@ -124,6 +138,7 @@ class ServeMetrics:
             "slot_occupancy",
             "prefill_s",
             "decode_s",
+            "decode_token_s",
         ):
             for k, v in getattr(self, name).snapshot().items():
                 out[f"{name}_{k}"] = v
@@ -140,5 +155,11 @@ class ServeMetrics:
         )
         out["wall_tokens_per_sec"] = (
             self.counters["tokens_generated"] / wall if wall > 0 else None
+        )
+        # the fused-decode headline: device->host round trips per emitted
+        # token (1 + 1/max_new at K=1, ~1/K once chunking amortizes them)
+        tokens = self.counters["tokens_generated"]
+        out["syncs_per_token"] = (
+            self.counters["host_syncs"] / tokens if tokens > 0 else None
         )
         return out

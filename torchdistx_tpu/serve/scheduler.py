@@ -4,7 +4,12 @@ FCFS with two admission gates: a free cache slot, and a max-tokens budget
 (the sum of ``prompt + max_new_tokens`` over running requests, capping the
 worst-case cache footprint a burst can claim).  New requests prefill into
 freed slots while the other slots keep decoding — admission never stalls
-the running batch, and nothing here touches the device.
+the running batch, and nothing here touches the device.  The engine calls
+``admit`` once per ``step()``, i.e. once per fused decode dispatch: with
+``decode_chunk=K`` a slot freed mid-chunk rejoins the free pool at the
+next chunk boundary, so the scheduler's admission granularity is the
+chunk, not the token (the at-most-``K-1`` idle slot-steps in between are
+the engine's ``masked_slot_steps``).
 
 Deadlines are wall-clock (``time.monotonic``): an expired request — queued
 or running — finishes immediately with whatever tokens it has, flagged
