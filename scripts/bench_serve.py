@@ -1,13 +1,18 @@
 """Serving throughput: continuous batching through ``serve.ServeEngine``,
-with a fused multi-step decode A/B.
+with a fused multi-step decode A/B and an optional shared-prefix A/B.
 
 Phases: the K=1 baseline FIRST (one host sync per token), then one phase
 per ``--decode-chunk`` value (K decode steps fused into one ``lax.scan``
-dispatch, one sync per K tokens).  Each phase submits a mixed-length
-request burst deeper than the slot count (slot churn, padded-bucket
-prefill, late admissions at chunk boundaries) and reports the metrics
-snapshot — tokens/s, syncs/token, p50/p95 per-token latency,
-masked_slot_steps.
+dispatch, one sync per K tokens), then — with ``--prefix-share`` — one
+paged-engine phase that runs the SAME repeated-system-prompt burst twice
+through one engine: cold (empty prefix index) and warm (index populated
+by the cold pass).  Warm prefill must compute strictly fewer padded
+tokens than cold (suffix-only prefill); the phase reports both passes'
+full metrics (``ServeMetrics.to_json()``) plus the warm prefix hit-rate
+and pages-in-use high water, and flags ``error`` when the inequality
+fails (so ``TDX_SERVE_STRICT`` CI catches a broken prefix cache).  Each
+phase embeds ``engine.metrics.to_json()`` verbatim under ``"metrics"`` —
+one schema for tests, bench, and CI to parse.
 
 Same output contract as bench.py: a FULL parseable JSON record is the
 LAST stdout line after EVERY phase, baseline included — so a relay that
@@ -50,6 +55,18 @@ def _parse_args():
         help="comma-separated fused-decode chunk sizes to A/B against the "
         "always-run K=1 baseline",
     )
+    ap.add_argument(
+        "--prefix-share",
+        action="store_true",
+        help="append a paged-engine phase A/Bing a repeated-system-prompt "
+        "burst cold vs warm (prefix cache empty vs populated)",
+    )
+    ap.add_argument(
+        "--page-size",
+        type=int,
+        default=16,
+        help="KV page size (tokens) for the --prefix-share phase",
+    )
     return ap.parse_args()
 
 
@@ -64,16 +81,29 @@ def _chunk_values(args) -> list:
 
 
 def _phase_summary(rec: dict) -> dict:
-    """The A/B headline numbers of one phase record."""
-    return {
-        "decode_tokens_per_sec": rec.get("decode_tokens_per_sec"),
-        "wall_tokens_per_sec": rec.get("wall_tokens_per_sec"),
-        "syncs_per_token": rec.get("syncs_per_token"),
-        "decode_token_s_p50": rec.get("decode_token_s_p50"),
-        "decode_token_s_p95": rec.get("decode_token_s_p95"),
-        "masked_slot_steps": rec.get("masked_slot_steps"),
+    """The A/B headline numbers of one phase record, lifted out of its
+    embedded ``metrics`` (``ServeMetrics.to_json()``) object."""
+    m = rec.get("metrics") or {}
+    derived = m.get("derived") or {}
+    counters = m.get("counters") or {}
+    hists = m.get("histograms") or {}
+    out = {
+        "decode_tokens_per_sec": derived.get("decode_tokens_per_sec"),
+        "wall_tokens_per_sec": derived.get("wall_tokens_per_sec"),
+        "syncs_per_token": derived.get("syncs_per_token"),
+        "decode_token_s_p50": (hists.get("decode_token_s") or {}).get("p50"),
+        "decode_token_s_p95": (hists.get("decode_token_s") or {}).get("p95"),
+        "masked_slot_steps": counters.get("masked_slot_steps"),
         "error": rec.get("error"),
     }
+    if "warm" in rec:  # the prefix-share phase
+        out.update(
+            prefix_hit_rate_warm=rec.get("prefix_hit_rate_warm"),
+            tokens_prefilled_cold=rec.get("tokens_prefilled_cold"),
+            tokens_prefilled_warm=rec.get("tokens_prefilled_warm"),
+            pages_in_use_hwm=rec.get("pages_in_use_hwm"),
+        )
+    return out
 
 
 def _supervise(args) -> None:
@@ -83,33 +113,47 @@ def _supervise(args) -> None:
     serial for the same reason."""
     deadline = float(os.environ.get("TDX_BENCH_DEADLINE", "1500"))
     t0 = time.monotonic()
+    chunks = _chunk_values(args)
     record: dict = {
         "bench": "serve",
         "model": os.environ.get("TDX_SERVE_MODEL", "llama_1b"),
         "deadline_s": deadline,
-        "decode_chunks": _chunk_values(args),
+        "decode_chunks": chunks,
         "phases": {},
     }
+    # phase plan: K=1 baseline, the chunk A/B, then (opt-in) the paged
+    # shared-prefix cold/warm A/B at the largest requested chunk
+    plan = [(f"k{k}", {"TDX_SERVE_CHUNK": str(k)}) for k in chunks]
+    if args.prefix_share:
+        plan.append(
+            (
+                "prefix_share",
+                {
+                    "TDX_SERVE_CHUNK": str(chunks[-1]),
+                    "TDX_SERVE_PHASE": "prefix_share",
+                },
+            )
+        )
 
     def emit():
+        # phases run (and are recorded) in plan order; dict order is the
+        # summary order
         record["summary"] = {
-            f"k{k}": _phase_summary(rec)
-            for k, rec in sorted(
-                ((int(name[1:]), r) for name, r in record["phases"].items())
-            )
+            name: _phase_summary(rec)
+            for name, rec in record["phases"].items()
         }
         print(json.dumps(record), flush=True)
 
-    for k in record["decode_chunks"]:
+    for name, phase_env in plan:
         left = deadline - (time.monotonic() - t0)
         if left <= 5:
-            record["phases"][f"k{k}"] = {
+            record["phases"][name] = {
                 "error": "global deadline exhausted before phase start"
             }
             emit()
             continue
         cmd = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
-        env = dict(os.environ, TDX_SERVE_CHILD="1", TDX_SERVE_CHUNK=str(k))
+        env = dict(os.environ, TDX_SERVE_CHILD="1", **phase_env)
         phase: dict = {}
         try:
             proc = subprocess.run(
@@ -133,10 +177,10 @@ def _supervise(args) -> None:
                 "error": f"deadline share ({left:.0f}s) exceeded — relay "
                 "wedge?"
             }
-            record["phases"][f"k{k}"] = phase
+            record["phases"][name] = phase
             emit()
             break  # a wedged relay poisons every later phase; stop here
-        record["phases"][f"k{k}"] = phase
+        record["phases"][name] = phase
         emit()  # full record after EVERY phase — the consumer contract
 
     _write_artifact(record)
@@ -191,22 +235,18 @@ def _write_artifact(record: dict) -> None:
         pass  # the stdout record is the contract; the file is a courtesy
 
 
-def _child(args) -> None:
-    """One phase: one engine at one decode_chunk, warm then measure."""
-    k_chunk = int(os.environ.get("TDX_SERVE_CHUNK", "1"))
-
+def _phase_setup(args, **extra) -> tuple:
+    """Shared child-phase bring-up: pin the requested platform BEFORE
+    the first jax op and build the common record header.  One
+    definition for every phase flavor, so a setup change (env knob,
+    platform pinning, dtype rule) can never leave one phase
+    benchmarking a differently-configured engine."""
     import jax
 
     plat = os.environ.get("TDX_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
-
-    import numpy as np
-
-    import torchdistx_tpu as tdx
-    from torchdistx_tpu.models import Llama
-    from torchdistx_tpu.serve import ServeEngine
-
+    k_chunk = int(os.environ.get("TDX_SERVE_CHUNK", "1"))
     name = os.environ.get("TDX_SERVE_MODEL", "llama_1b")
     record: dict = {
         "bench": "serve",
@@ -216,15 +256,34 @@ def _child(args) -> None:
         "max_new_tokens": args.max_new,
         "num_slots": args.slots,
         "decode_chunk": k_chunk,
+        **extra,
     }
+    return record, name, k_chunk, plat
+
+
+def _build_model(name: str, plat):
+    import jax.numpy as jnp
+
+    import torchdistx_tpu as tdx
+    from torchdistx_tpu.models import Llama
+
+    dtype = jnp.bfloat16 if plat != "cpu" else jnp.float32
+    tdx.manual_seed(0)
+    model = tdx.deferred_init(Llama.from_name, name, dtype=dtype)
+    tdx.materialize_module(model)
+    return model
+
+
+def _child(args) -> None:
+    """One phase: one engine at one decode_chunk, warm then measure."""
+    record, name, k_chunk, plat = _phase_setup(args)
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine
+
     try:
-        import jax.numpy as jnp
-
-        dtype = jnp.bfloat16 if plat != "cpu" else jnp.float32
-        tdx.manual_seed(0)
-        model = tdx.deferred_init(Llama.from_name, name, dtype=dtype)
-        tdx.materialize_module(model)
-
+        model = _build_model(name, plat)
         limit = model.cfg.max_seq_len
         max_len = args.max_len or min(limit, 8 * args.max_new)
         engine = ServeEngine(
@@ -276,7 +335,7 @@ def _child(args) -> None:
         )
         wall = time.perf_counter() - t0
 
-        record.update(engine.metrics.snapshot())
+        record["metrics"] = engine.metrics.to_json()
         record.update(
             max_len=max_len,
             drain_wall_s=round(wall, 3),
@@ -290,10 +349,119 @@ def _child(args) -> None:
     print(json.dumps(record))
 
 
+def _child_prefix(args) -> None:
+    """The shared-prefix A/B phase: ONE paged engine, the SAME
+    repeated-system-prompt burst twice — cold (empty radix index) then
+    warm (index populated by the cold pass).  Metrics reset between
+    passes, so each pass's ``to_json()`` is self-contained; the headline
+    is warm prefill tokens strictly below cold (suffix-only prefill)."""
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="prefix_share", page_size=args.page_size
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine
+    from torchdistx_tpu.serve.metrics import ServeMetrics
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        ps = args.page_size
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        # paged geometry needs max_len | page_size: round UP (capped at
+        # the model limit's own page multiple) — rounding down could
+        # zero out a small --max-new budget entirely
+        max_len = min(-(-max_len // ps) * ps, limit - limit % ps)
+        engine = ServeEngine(
+            model,
+            num_slots=args.slots,
+            max_len=max_len,
+            decode_chunk=k_chunk,
+            page_size=ps,
+        )
+        # the production shape: every request opens with the same long
+        # system prompt, tails differ
+        rs = np.random.RandomState(0)
+        max_prompt = max(1, min(max_len - args.max_new, max_len // 2))
+        sys_len = max(ps, (max_prompt // 2) - (max_prompt // 2) % ps)
+        system = rs.randint(0, 256, (sys_len,)).astype(np.int32)
+        burst = []
+        for i in range(args.requests):
+            tail = rs.randint(
+                0, 256, (1 + int(rs.randint(0, max(1, max_prompt - sys_len))),)
+            ).astype(np.int32)
+            burst.append(
+                {
+                    "prompt": np.concatenate([system, tail])[:max_prompt],
+                    "max_new_tokens": args.max_new,
+                    "temperature": args.temperature,
+                    "seed": i,
+                }
+            )
+
+        def run_pass():
+            engine.metrics = ServeMetrics(engine.num_slots, engine.num_pages)
+            t0 = time.perf_counter()
+            results = engine.run([dict(r) for r in burst])
+            wall = time.perf_counter() - t0
+            return {
+                "metrics": engine.metrics.to_json(),
+                "drain_wall_s": round(wall, 3),
+                "finish_reasons": sorted(
+                    {r.finish_reason for r in results}
+                ),
+            }
+
+        # Warm every reachable program past the donated-carry recompile
+        # (CLAUDE.md: never time the second call): one throwaway burst
+        # compiles the COLD prefill buckets + decode scan, a second
+        # compiles the WARM (prefix-hit) prefill family those hits
+        # unlock.  Then evict the index back to empty so the timed cold
+        # pass is cold of CONTENT while the programs stay compiled —
+        # otherwise the warm pass would be charged its own program
+        # family's XLA compiles and could read slower than cold.
+        engine.run([dict(r) for r in burst])
+        engine.run([dict(r) for r in burst])
+        engine.prefix_index.evict(engine.pool, engine.pool.capacity)
+
+        record["cold"] = run_pass()
+        record["warm"] = run_pass()
+        cold_m, warm_m = record["cold"]["metrics"], record["warm"]["metrics"]
+        record["tokens_prefilled_cold"] = cold_m["counters"][
+            "tokens_prefilled"
+        ]
+        record["tokens_prefilled_warm"] = warm_m["counters"][
+            "tokens_prefilled"
+        ]
+        record["prefill_calls_cold"] = cold_m["counters"]["prefill_calls"]
+        record["prefill_calls_warm"] = warm_m["counters"]["prefill_calls"]
+        record["prefix_hit_rate_warm"] = warm_m["derived"]["prefix_hit_rate"]
+        record["pages_in_use_hwm"] = warm_m["gauges"]["pages_in_use_hwm"]
+        # the phase's whole point: the warm cache must shrink prefill
+        # work — surface a broken prefix cache as a phase error so the
+        # STRICT nightly fails on it
+        if not record["tokens_prefilled_warm"] < record["tokens_prefilled_cold"]:
+            record["error"] = (
+                "warm prefix cache did not reduce prefill tokens "
+                f"({record['tokens_prefilled_warm']} vs "
+                f"{record['tokens_prefilled_cold']} cold)"
+            )
+        # the warm pass's full metrics double as the phase metrics for
+        # the shared summary schema
+        record["metrics"] = warm_m
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def main() -> None:
     args = _parse_args()
     if os.environ.get("TDX_SERVE_CHILD") == "1":
-        _child(args)
+        if os.environ.get("TDX_SERVE_PHASE") == "prefix_share":
+            _child_prefix(args)
+        else:
+            _child(args)
     else:
         _supervise(args)
 

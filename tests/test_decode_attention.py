@@ -19,7 +19,10 @@ import jax
 import jax.numpy as jnp
 
 from torchdistx_tpu.ops.attention import slot_cached_attention
-from torchdistx_tpu.ops.decode_attention import decode_attention
+from torchdistx_tpu.ops.decode_attention import (
+    decode_attention,
+    paged_decode_attention,
+)
 
 _ULP = 3e-7  # ~2 f32 ulps at unit scale
 
@@ -156,6 +159,205 @@ class TestRouting:
             decode_attention(q, ck, ck, jnp.zeros((2,), jnp.int32))
 
 
+def _paged_case(rs, b, hq, hkv, d, pp, ps, positions, dtype=jnp.float32):
+    """Pools + a shuffled page-table (identity mappings would let a
+    kernel that ignores the table pass) + per-slot new K/V."""
+    num_pages = b * pp + 1  # page 0 stays scratch, like the engine's pool
+    q = jnp.asarray(rs.randn(b, 1, hq, d), dtype)
+    k = jnp.asarray(rs.randn(b, 1, hkv, d), dtype)
+    v = jnp.asarray(rs.randn(b, 1, hkv, d), dtype)
+    pools = (
+        jnp.asarray(rs.randn(num_pages, ps, hkv, d), dtype),
+        jnp.asarray(rs.randn(num_pages, ps, hkv, d), dtype),
+    )
+    tables = 1 + rs.permutation(b * pp).reshape(b, pp).astype(np.int32)
+    return (
+        q, k, v, pools,
+        jnp.asarray(tables), jnp.asarray(positions, jnp.int32),
+    )
+
+
+class TestPagedKernel:
+    """paged_decode_attention vs the jnp paged path (page-table gather +
+    the shared _slot_attend math) — same exactness bar as the slot
+    kernel: single-page rows bitwise-softmax (<= ULP overall), multi-page
+    rows the online-softmax merge at <= 2 f32 ulps."""
+
+    def _ref_and_kernel(self, q, k, v, pools, tables, pos):
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=False, page_tables=tables
+        )
+        out = paged_decode_attention(q, rk, rv, tables, pos, interpret=True)
+        return np.asarray(ref), np.asarray(out), (rk, rv)
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2), (16, 1)])
+    def test_single_page_matches_jnp_path(self, hq, hkv):
+        rs = np.random.RandomState(hq * 10 + hkv)
+        b, d, ps = 3, 8, 16
+        case = _paged_case(rs, b, hq, hkv, d, 1, ps, rs.randint(0, ps, (b,)))
+        ref, out, _ = self._ref_and_kernel(*case)
+        np.testing.assert_allclose(out, ref, rtol=_ULP, atol=_ULP)
+
+    @pytest.mark.parametrize("ps", [8, 16])
+    def test_multi_page_online_softmax_matches(self, ps):
+        rs = np.random.RandomState(ps)
+        b, hq, hkv, d, pp = 4, 4, 2, 8, 4
+        # positions straddling page edges: first page only, exact edge,
+        # mid-chain, last row
+        case = _paged_case(
+            rs, b, hq, hkv, d, pp, ps,
+            [ps - 1, ps, 2 * ps + 3, pp * ps - 1],
+        )
+        ref, out, _ = self._ref_and_kernel(*case)
+        np.testing.assert_allclose(out, ref, rtol=_ULP, atol=_ULP)
+
+    def test_matches_contiguous_layout_bitwise_on_jnp_path(self):
+        """The jnp paged path IS the slab path behind a gather: build a
+        slab holding exactly what the page chains spell and pin the
+        outputs (and written rows) bit-for-bit."""
+        rs = np.random.RandomState(5)
+        b, hq, hkv, d, pp, ps = 3, 4, 2, 8, 4, 8
+        q, k, v, pools, tables, pos = _paged_case(
+            rs, b, hq, hkv, d, pp, ps, [3, 17, 30]
+        )
+        slab = tuple(
+            jnp.stack([p.reshape(-1, hkv, d)[
+                (np.asarray(tables[row])[:, None] * ps
+                 + np.arange(ps)[None, :]).reshape(-1)
+            ] for row in range(b)])
+            for p in pools
+        )
+        want, _ = slot_cached_attention(
+            q, k, v, slab, pos, use_flash=False
+        )
+        got, (gk, gv) = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=False, page_tables=tables
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # the write landed at page tables[b, pos//ps], offset pos%ps
+        for row, p in enumerate([3, 17, 30]):
+            page = int(tables[row, p // ps])
+            np.testing.assert_array_equal(
+                np.asarray(gk[page, p % ps]), np.asarray(k[row, 0])
+            )
+
+    def test_routing_through_slot_cached_attention(self):
+        rs = np.random.RandomState(6)
+        q, k, v, pools, tables, pos = _paged_case(
+            rs, 2, 4, 2, 8, 2, 16, [5, 20]
+        )
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=False, page_tables=tables
+        )
+        out, (fk, fv) = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=True, page_tables=tables
+        )
+        np.testing.assert_array_equal(np.asarray(fk), np.asarray(rk))
+        np.testing.assert_array_equal(np.asarray(fv), np.asarray(rv))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    def test_tiny_pages_fall_back_to_jnp(self):
+        """Pages below the f32 sublane height can't feed the kernel on
+        real TPUs: use_flash must quietly take the gather path."""
+        rs = np.random.RandomState(7)
+        q, k, v, pools, tables, pos = _paged_case(
+            rs, 2, 4, 2, 8, 4, 4, [3, 11]
+        )
+        ref, _ = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=False, page_tables=tables
+        )
+        out, _ = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=True, page_tables=tables
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_rejects_bad_shapes(self):
+        rs = np.random.RandomState(8)
+        q = jnp.asarray(rs.randn(2, 2, 4, 8), jnp.float32)
+        pool = jnp.asarray(rs.randn(5, 16, 2, 8), jnp.float32)
+        pt = jnp.zeros((2, 2), jnp.int32)
+        with pytest.raises(ValueError, match="one token per slot"):
+            paged_decode_attention(q, pool, pool, pt, jnp.zeros(2, jnp.int32))
+        q1 = jnp.asarray(rs.randn(3, 1, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="page_tables rows"):
+            paged_decode_attention(
+                q1, pool, pool, pt, jnp.zeros(3, jnp.int32)
+            )
+
+
+class TestWindowedDecodeBoundaries:
+    """Windowed slot_cached_attention vs an independently computed dense
+    reference, at the boundaries the paged refactor could plausibly
+    break: window == page_size, window < prompt depth, and a window
+    straddling a page edge.  The paged windowed path must also stay
+    bit-identical to the slab windowed path (both run the shared
+    _slot_attend on the same visible values)."""
+
+    def _dense_reference(self, q, ck, cv, positions, window):
+        """Per-row, slice the exact visible band and softmax over it —
+        no masking tricks shared with the implementation under test."""
+        outs = []
+        for row, p in enumerate(positions):
+            lo = max(0, int(p) - window + 1)
+            ks = np.asarray(ck[row, lo : int(p) + 1], np.float32)
+            vs = np.asarray(cv[row, lo : int(p) + 1], np.float32)
+            qv = np.asarray(q[row, 0], np.float32)  # (Hq, D)
+            n_rep = qv.shape[0] // ks.shape[1]
+            ks = np.repeat(ks, n_rep, axis=1)
+            vs = np.repeat(vs, n_rep, axis=1)
+            logits = np.einsum("hd,khd->hk", qv, ks) / np.sqrt(qv.shape[-1])
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            outs.append(np.einsum("hk,khd->hd", probs, vs))
+        return np.stack(outs)[:, None]
+
+    @pytest.mark.parametrize(
+        "window,positions",
+        [
+            (8, [7, 12, 20]),   # window == page_size (ps=8 in the grid)
+            (5, [9, 15, 23]),   # window < prompt depth everywhere
+            (6, [11, 8, 19]),   # band straddles a page edge (8, 16)
+        ],
+    )
+    def test_windowed_matches_dense_reference(self, window, positions):
+        rs = np.random.RandomState(window)
+        b, hq, hkv, d, max_seq = 3, 4, 2, 8, 32
+        q, k, v, cache, pos = _case(rs, b, hq, hkv, d, max_seq, positions)
+        out, (ck, cv) = slot_cached_attention(
+            q, k, v, cache, pos, window=window, use_flash=False
+        )
+        ref = self._dense_reference(q, ck, cv, positions, window)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=1e-6, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("window", [5, 8, 6])
+    def test_paged_windowed_bitwise_matches_slab(self, window):
+        rs = np.random.RandomState(20 + window)
+        b, hq, hkv, d, pp, ps = 3, 4, 2, 8, 4, 8
+        positions = [11, 8, 19]
+        q, k, v, pools, tables, pos = _paged_case(
+            rs, b, hq, hkv, d, pp, ps, positions
+        )
+        slab = tuple(
+            jnp.stack([p.reshape(-1, hkv, d)[
+                (np.asarray(tables[row])[:, None] * ps
+                 + np.arange(ps)[None, :]).reshape(-1)
+            ] for row in range(b)])
+            for p in pools
+        )
+        want, _ = slot_cached_attention(
+            q, k, v, slab, pos, window=window, use_flash=False
+        )
+        got, _ = slot_cached_attention(
+            q, k, v, pools, pos, window=window, use_flash=False,
+            page_tables=tables,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 @pytest.mark.slow
 class TestKernelSweep:
     """Full grid of (GQA width, geometry, block split, position pattern) —
@@ -174,6 +376,26 @@ class TestKernelSweep:
             q, k, v, cache, pos, use_flash=False
         )
         out = decode_attention(q, rk, rv, pos, block_k=block_k, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
+        )
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2), (8, 1)])
+    @pytest.mark.parametrize("pp,ps", [(1, 16), (4, 8), (4, 32)])
+    def test_paged_grid(self, hq, hkv, pp, ps):
+        rs = np.random.RandomState(hq + hkv + pp * ps)
+        b, d = 4, 16
+        max_seq = pp * ps
+        positions = np.concatenate(
+            [[0, max_seq - 1], rs.randint(0, max_seq, (b - 2,))]
+        )
+        q, k, v, pools, tables, pos = _paged_case(
+            rs, b, hq, hkv, d, pp, ps, positions
+        )
+        ref, (rk, rv) = slot_cached_attention(
+            q, k, v, pools, pos, use_flash=False, page_tables=tables
+        )
+        out = paged_decode_attention(q, rk, rv, tables, pos, interpret=True)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=_ULP, atol=_ULP
         )

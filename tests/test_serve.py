@@ -16,6 +16,12 @@ The load-bearing invariants, pinned on the 8-device CPU mesh:
   including a late request admitted into a freed (dirty) slot — compiles
   exactly two programs (one prefill bucket + one decode scan per
   ``decode_chunk`` value).
+- **Paged prefix-cache exactness**: a ``page_size=N`` engine — shared
+  prefixes served from cached pages, suffix-only prefill, page-table
+  decode — emits BIT-identical token streams to the contiguous
+  (cache-off) engine across K x occupancy x shared/disjoint prefix
+  mixes, cold AND warm (tests/test_prefix_cache.py covers the allocator
+  and index units).
 - **Deadlines**: expiry returns a partial result flagged ``truncated``.
 """
 
@@ -367,6 +373,106 @@ class TestFusedDecode:
     @pytest.mark.parametrize("temperature", [0.0, 0.9])
     def test_full_grid_bit_identical(self, k_chunk, lengths, temperature):
         self._assert_identical(k_chunk, lengths, temperature)
+
+
+class TestPagedPrefixSharing:
+    """page_size=N engine vs the contiguous cache-off engine: BIT
+    identical streams, cold and warm — shared prefixes, disjoint
+    prompts, slot churn, greedy and sampled rows.  The fast tests cover
+    K=4 at both occupancies plus a warm pass; the slow sweep runs the
+    full K x occupancy x prefix-mix grid (same code path, nightly)."""
+
+    # prefix mixes: lengths with None meaning "prepend the shared
+    # 20-token system prefix" (page-aligned hits at page_size=8 come
+    # from its first 16 tokens)
+    SHARED = (("s", 5), ("s", 9), (None, 3), ("s", 12), (None, 7))
+    DISJOINT = ((None, 6), (None, 11), (None, 9), (None, 4), (None, 13))
+
+    def _requests(self, mix, temperature, n_new=8):
+        rs = np.random.RandomState(17)
+        shared = rs.randint(0, 256, (20,)).astype(np.int32)
+        reqs = []
+        for i, (pfx, n) in enumerate(mix):
+            tail = rs.randint(0, 256, (n,)).astype(np.int32)
+            prompt = np.concatenate([shared, tail]) if pfx else tail
+            reqs.append(
+                {"prompt": prompt, "max_new_tokens": n_new,
+                 "temperature": temperature, "seed": i}
+            )
+        return reqs
+
+    def _assert_paged_identical(self, k_chunk, mix, temperature,
+                                num_slots=3):
+        model = _llama()
+        reqs = self._requests(mix, temperature)
+        _, base = _run_chunked(
+            model, k_chunk, reqs, num_slots=num_slots, buckets=(16, 32)
+        )
+        paged = ServeEngine(
+            model, num_slots=num_slots, max_len=64,
+            prefill_buckets=(16, 32), decode_chunk=k_chunk, page_size=8,
+        )
+        cold = paged.run([dict(r) for r in reqs])
+        warm = paged.run([dict(r) for r in reqs])  # index now populated
+        for a, b, c in zip(base, cold, warm):
+            assert a.finish_reason == b.finish_reason == c.finish_reason
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+            np.testing.assert_array_equal(a.tokens, c.tokens)
+        return paged
+
+    def test_k4_greedy_shared_prefix_cold_and_warm(self):
+        engine = self._assert_paged_identical(4, self.SHARED, 0.0)
+        snap = engine.metrics.snapshot()
+        assert snap["prefix_hit_tokens"] > 0  # sharing actually happened
+        # partial occupancy: one request, slots idle
+        self._assert_paged_identical(4, ((None, 7),), 0.0)
+
+    def test_k4_sampled_shared_prefix(self):
+        self._assert_paged_identical(4, self.SHARED, 0.9)
+
+    def test_k1_disjoint_prompts(self):
+        engine = self._assert_paged_identical(1, self.DISJOINT, 0.0)
+        # disjoint tails shorter than a page: no false hits on the cold
+        # pass (the warm pass legitimately hits its own full prompts)
+        assert engine.metrics.counters["requests_completed"] == 10
+
+    def test_paged_through_pallas_kernel_path(self):
+        """use_flash=True routes the paged decode through the
+        interpret-mode paged kernel: paged-vs-slab streams stay
+        BIT-identical because both layouts share the kernel math."""
+        tdx.manual_seed(0)
+        model = Llama.from_name(
+            "tiny", n_kv_heads=2, max_seq_len=64, use_flash=True
+        )
+        reqs = self._requests(self.SHARED[:3], 0.0, n_new=6)
+        _, base = _run_chunked(
+            model, 4, reqs, num_slots=2, buckets=(16, 32)
+        )
+        paged = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16, 32),
+            decode_chunk=4, page_size=16,
+        )
+        got = paged.run([dict(r) for r in reqs])
+        for a, b in zip(base, got):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_program_count_stable_after_warmup(self):
+        """Paged dispatch discipline: one cold + (if hits occur) one
+        warm prefill per bucket used, one decode scan — and MORE traffic
+        through the warm engine never compiles another program."""
+        engine = self._assert_paged_identical(4, self.SHARED, 0.0)
+        warm = engine.num_compiled_programs()
+        if warm is None:
+            pytest.skip("jit cache introspection unavailable on this jax")
+        engine.run([dict(r) for r in self._requests(self.SHARED, 0.0)])
+        assert engine.num_compiled_programs() == warm
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k_chunk", [1, 4, 8])
+    @pytest.mark.parametrize("mix", [SHARED, DISJOINT, ((None, 7),)])
+    @pytest.mark.parametrize("temperature", [0.0, 0.9])
+    def test_full_grid_bit_identical(self, k_chunk, mix, temperature):
+        self._assert_paged_identical(k_chunk, mix, temperature)
 
 
 class TestFinishMasking:

@@ -139,15 +139,20 @@ def _make_fused_decode(
     what makes fused-vs-sequential cache states comparable.
 
     Returns ``run(params, kv, toks, positions, temps, seeds, steps,
-    budgets, finished) -> (kv, (K, B) token block)``.
+    budgets, finished, *extra) -> (kv, (K, B) token block)``.  ``extra``
+    is empty for the contiguous slot cache; the PAGED engine passes its
+    device page tables there — scan-invariant (a request's full
+    page-aligned footprint is allocated at admission, so no chunk ever
+    needs a page the table doesn't already name) and forwarded to
+    ``forward_decode`` each step.
     """
 
     def run(params, kv, toks, positions, temps, seeds, steps, budgets,
-            finished):
+            finished, *extra):
         def body(carry, _):
             kv, tok, pos, stp, fin = carry
             logits, kv = functional_call(
-                model, params, (tok[:, None], kv, pos),
+                model, params, (tok[:, None], kv, pos) + extra,
                 method="forward_decode",
             )
             sampled = sampler(logits[:, -1, :], temps, seeds, stp)

@@ -128,17 +128,19 @@ class GPT2Block(nn.Module):
         h = self.ln2(x)
         return x + self.mlp_down(F.gelu(self.mlp_up(h))), cache
 
-    def forward_decode(self, x, cache, positions):
+    def forward_decode(self, x, cache, positions, page_tables=None):
         """One-token batched decode with PER-ROW cache positions (serving
         slots) — the ``slot_cached_attention`` sibling of
-        ``forward_cached``."""
+        ``forward_cached``.  ``page_tables`` selects the paged pool
+        layout (``serve/kv_cache.py``)."""
         b, s, d = x.shape
         hd = d // self.n_heads
         h = self.ln1(x)
         qkv = self.attn_qkv(h).reshape(b, s, 3, self.n_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         a, cache = slot_cached_attention(
-            q, k, v, cache, positions, use_flash=self.use_flash
+            q, k, v, cache, positions, use_flash=self.use_flash,
+            page_tables=page_tables,
         )
         x = x + self.attn_out(a.reshape(b, s, d))
         h = self.ln2(x)
@@ -222,15 +224,16 @@ class GPT2(nn.Module):
         x = self.ln_f(x)
         return x @ self.tok_emb.weight.T, new_cache
 
-    def forward_decode(self, tokens, cache, positions):
+    def forward_decode(self, tokens, cache, positions, page_tables=None):
         """One decode step for a batch of independent serving slots:
         ``tokens`` (B, 1), ``positions`` (B,) int32 per-row cache depths.
-        Returns (logits, new_cache); same cache pytree as
-        ``forward_cached``."""
+        With ``page_tables`` the cache pytree is the per-layer page
+        pools (``serve/kv_cache.py``).  Returns (logits, new_cache);
+        same cache pytree as it was given."""
         x = self.tok_emb(tokens) + self.pos_emb(positions)[:, None]
         new_cache = []
         for blk, c in zip(self.blocks, cache):
-            x, c = blk.forward_decode(x, c, positions)
+            x, c = blk.forward_decode(x, c, positions, page_tables)
             new_cache.append(c)
         x = self.ln_f(x)
         return x @ self.tok_emb.weight.T, new_cache

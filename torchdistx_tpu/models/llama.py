@@ -254,10 +254,13 @@ class LlamaAttention(nn.Module):
         )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 
-    def forward_decode(self, x, rope, cache, positions):
+    def forward_decode(self, x, rope, cache, positions, page_tables=None):
         """One-token batched decode with PER-ROW cache positions (serving
         slots): ``x`` is (B, 1, dim), ``positions`` (B,) int32.  Same math
-        as ``forward_cached`` at ``s == 1``, row for row."""
+        as ``forward_cached`` at ``s == 1``, row for row.  With
+        ``page_tables`` (B, pages_per_slot) int32 the cache is the paged
+        pool layout (``serve/kv_cache.py``) instead of a contiguous
+        slab — same attention contract either way."""
         b, s, _ = x.shape
         cfg = self.cfg
         q = self.wq(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
@@ -267,7 +270,7 @@ class LlamaAttention(nn.Module):
         k = apply_rope_at(k, rope, positions)
         out, cache = slot_cached_attention(
             q, k, v, cache, positions, window=cfg.sliding_window,
-            use_flash=cfg.use_flash,
+            use_flash=cfg.use_flash, page_tables=page_tables,
         )
         return self.wo(out.reshape(b, s, cfg.n_heads * cfg.head_dim)), cache
 
@@ -307,9 +310,9 @@ class LlamaBlock(nn.Module):
         x = x + a
         return x + self.mlp(self.mlp_norm(x)), cache
 
-    def forward_decode(self, x, rope, cache, positions):
+    def forward_decode(self, x, rope, cache, positions, page_tables=None):
         a, cache = self.attn.forward_decode(
-            self.attn_norm(x), rope, cache, positions
+            self.attn_norm(x), rope, cache, positions, page_tables
         )
         x = x + a
         return x + self.mlp(self.mlp_norm(x)), cache
@@ -392,19 +395,20 @@ class Llama(nn.Module):
         x = self.norm(x)
         return self.lm_head(x), new_cache
 
-    def forward_decode(self, tokens, cache, positions):
+    def forward_decode(self, tokens, cache, positions, page_tables=None):
         """One decode step for a batch of independent serving slots:
         ``tokens`` (B, 1), ``positions`` (B,) int32 — row ``b``'s token
         is written at its own cache depth ``positions[b]``
-        (``ops.attention.slot_cached_attention``).  Returns (logits,
-        new_cache); same cache-ins/cache-outs pytree as
-        ``forward_cached``."""
+        (``ops.attention.slot_cached_attention``).  With ``page_tables``
+        the cache pytree is the per-layer page pools and row ``b``'s
+        depth indexes its page chain.  Returns (logits, new_cache); same
+        cache-ins/cache-outs pytree as it was given."""
         cfg = self.cfg
         x = self.tok_emb(tokens)
         rope = _rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
         new_cache = []
         for blk, c in zip(self.blocks, cache):
-            x, c = blk.forward_decode(x, rope, c, positions)
+            x, c = blk.forward_decode(x, rope, c, positions, page_tables)
             new_cache.append(c)
         x = self.norm(x)
         return self.lm_head(x), new_cache

@@ -163,6 +163,43 @@ def cached_attention(
     return out, (ck, cv)
 
 
+def _slot_attend(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    positions: jax.Array,
+    scale: Optional[float],
+    window: Optional[int],
+) -> jax.Array:
+    """The jnp per-slot attend shared by the contiguous and paged decode
+    paths: ``ck``/``cv`` are (B, max_seq, Hkv, D) — the slab itself or a
+    page-table gather of it — and row ``b`` attends rows
+    ``j <= positions[b]`` (within the trailing ``window`` when set).  One
+    definition so the two layouts can never diverge bitwise: a gathered
+    view holds the same visible values as the slab, and the masked tail
+    (bucket padding, stale pages) contributes exactly-zero probability
+    either way."""
+    b, s, hq, d = q.shape
+    max_seq, hkv = ck.shape[1], ck.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # GQA broadcast mirrors the scalar path's _repeat_kv + einsum exactly.
+    # A grouped einsum (query heads folded onto their kv head) would skip
+    # materializing the repeated cache — measured here, it changes the
+    # contraction's bitwise result, and bit-identity with single-request
+    # decode is this primitive's contract (tests/test_serve.py); revisit
+    # together with the scalar path if that trade is renegotiated.
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+    slots = jnp.arange(max_seq)[None, :]
+    visible = slots <= positions[:, None]  # (B, max_seq)
+    if window is not None:
+        visible = visible & (slots > positions[:, None] - window)
+    logits = jnp.where(visible[:, None, None, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+
+
 def slot_cached_attention(
     q: jax.Array,
     k_new: jax.Array,
@@ -173,6 +210,7 @@ def slot_cached_attention(
     scale: Optional[float] = None,
     window: Optional[int] = None,
     use_flash: Optional[bool] = None,
+    page_tables: Optional[jax.Array] = None,
 ):
     """Single-token batched decode where each batch row sits at its OWN
     cache depth — the continuous-batching sibling of
@@ -201,6 +239,19 @@ def slot_cached_attention(
     paths, and the kernel's single-K-block configuration is
     bit-identical to the jnp path in interpret mode
     (``ops/decode_attention.py`` docstring); windowed decode stays jnp.
+
+    **Paged cache**: with ``page_tables`` (B, pages_per_slot) int32 set,
+    ``cache`` is instead the per-layer page pools of shape
+    ``(num_pages, page_size, Hkv, D)`` and row ``b``'s logical cache is
+    the concatenation of the pages ``page_tables[b]`` names.  The new
+    K/V are scattered to ``page_tables[b, positions[b] // page_size]``
+    at offset ``positions[b] % page_size``; the attend either runs the
+    paged pallas kernel (K/V gathered page-by-page through the
+    scalar-prefetched table, block == page) or gathers the logical view
+    and applies the IDENTICAL jnp math as the contiguous path
+    (``_slot_attend``) — a gather reproduces the slab's visible values
+    bitwise, so paged and contiguous greedy streams are bit-identical
+    (the engine-level contract tests/test_serve.py pins).
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -210,36 +261,51 @@ def slot_cached_attention(
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     ck, cv = cache
+    from .flash_attention import resolve_use_flash
+
+    if page_tables is not None:
+        ps = ck.shape[1]
+        pp = page_tables.shape[1]
+        flat = lambda c: c.reshape(-1, *c.shape[2:])  # noqa: E731
+        # the write: one pool row per slot.  A slot's current tail page
+        # is exclusively owned (sharing is full-prefix-pages only), so
+        # the scatter indices of ACTIVE slots never collide; retired
+        # slots' tables all name the scratch page, whose bits are never
+        # visible to any query.
+        rows = (
+            page_tables[jnp.arange(b), positions // ps] * ps
+            + positions % ps
+        )
+        fk = flat(ck).at[rows].set(k_new[:, 0].astype(ck.dtype))
+        fv = flat(cv).at[rows].set(v_new[:, 0].astype(cv.dtype))
+        ck, cv = fk.reshape(ck.shape), fv.reshape(cv.shape)
+        # the paged kernel needs >= sublane-height pages on real TPUs;
+        # tiny pages stay on the gather path
+        if window is None and ps >= 8 and resolve_use_flash(use_flash):
+            from .decode_attention import paged_decode_attention
+
+            out = paged_decode_attention(
+                q, ck, cv, page_tables, positions, scale=scale
+            )
+            return out, (ck, cv)
+        view_rows = (
+            page_tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+        ).reshape(b, pp * ps)
+        out = _slot_attend(
+            q, fk[view_rows], fv[view_rows], positions, scale, window
+        )
+        return out, (ck, cv)
     write = lambda c, x, p: lax.dynamic_update_slice(  # noqa: E731
         c, x.astype(c.dtype), (p, 0, 0)
     )
     ck = jax.vmap(write)(ck, k_new, positions)
     cv = jax.vmap(write)(cv, v_new, positions)
-    from .flash_attention import resolve_use_flash
-
     if window is None and resolve_use_flash(use_flash):
         from .decode_attention import decode_attention
 
         out = decode_attention(q, ck, cv, positions, scale=scale)
         return out, (ck, cv)
-    max_seq, hkv = ck.shape[1], ck.shape[2]
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # GQA broadcast mirrors the scalar path's _repeat_kv + einsum exactly.
-    # A grouped einsum (query heads folded onto their kv head) would skip
-    # materializing the repeated cache — measured here, it changes the
-    # contraction's bitwise result, and bit-identity with single-request
-    # decode is this primitive's contract (tests/test_serve.py); revisit
-    # together with the scalar path if that trade is renegotiated.
-    kk = _repeat_kv(ck, hq // hkv)
-    vv = _repeat_kv(cv, hq // hkv)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
-    slots = jnp.arange(max_seq)[None, :]
-    visible = slots <= positions[:, None]  # (B, max_seq)
-    if window is not None:
-        visible = visible & (slots > positions[:, None] - window)
-    logits = jnp.where(visible[:, None, None, :], logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    out = _slot_attend(q, ck, cv, positions, scale, window)
     return out, (ck, cv)
 
 

@@ -27,6 +27,14 @@ Layout and masking:
   visible one so their DMAs are no-ops, and the diagonal block applies
   the ``j <= positions[b]`` mask elementwise.
 
+``paged_decode_attention`` is the same kernel over the serve engine's
+PAGED cache (``serve/kv_cache.py``): K/V live as per-layer page pools
+``(num_pages, page_size, Hkv, D)`` and each slot's logical row is the
+chain of pages its scalar-prefetched page-table row names.  The K block
+is the page — the index map does the gather, the kernel body is shared —
+so shared-prefix pages are attended in place, never copied to a
+contiguous buffer.
+
 Exactness contract (pinned in tests/test_decode_attention.py): when the
 whole row fits one K block (``max_len <= block_k``, the common serving
 geometry) the kernel computes mask -> rowmax -> exp -> sum -> divide ->
@@ -57,7 +65,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _CompilerParams, _shrink_block
 
-__all__ = ["decode_attention"]
+__all__ = ["decode_attention", "paged_decode_attention"]
 
 _NEG_INF = -1e30
 _MIN_ROWS = 8  # f32 sublane minimum: GQA group rows pad up to this
@@ -227,4 +235,115 @@ def decode_attention(
         ),
         interpret=interpret,
     )(positions, qg, ck, cv)
+    return out[:, :, :n_rep, :].reshape(b, 1, hq, d)
+
+
+def _paged_decode_kernel(pos_ref, pt_ref, *refs, scale, block_k, n_k):
+    """The paged grid's kernel body IS the slot kernel's: the page table
+    is consumed entirely by the K/V index maps (which block to DMA); the
+    in-block math — masking against ``pos``, online softmax, GQA rows —
+    is position-indexed exactly as in the contiguous layout, so the two
+    kernels cannot diverge."""
+    del pt_ref
+    _decode_kernel(pos_ref, *refs, scale=scale, block_k=block_k, n_k=n_k)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    page_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Paged single-token decode attention (post-write): the serving
+    engine's prefix-sharing sibling of :func:`decode_attention`.
+
+    ``q``: (B, 1, Hq, D).  ``ck``/``cv``: the per-layer page pools,
+    shape (num_pages, page_size, Hkv, D), the new K/V already scattered
+    at each slot's current row (``slot_cached_attention`` performs the
+    write).  ``page_tables``: (B, pages_per_slot) int32 — slot ``b``'s
+    logical cache is the concatenation of the pages ``page_tables[b]``
+    names.  ``positions``: (B,) int32 visible depths as in the slot
+    kernel.  Returns (B, 1, Hq, D) in ``q.dtype``.
+
+    The K block IS the page (``block_k == page_size``): the grid's K/V
+    index map reads the scalar-prefetched page table to pick which pool
+    page to DMA — K/V are gathered page-by-page straight off the pool,
+    never copied into a contiguous buffer.  Block pruning and the
+    DMA-clamp work as in the slot kernel, but in TABLE space: blocks
+    past ``positions[b] // page_size`` re-map onto the slot's last
+    visible page.  When one page covers the whole logical row
+    (``pages_per_slot == 1``) the kernel takes the same
+    bit-exact-softmax fast path the slot kernel pins; multi-page rows
+    take the online-softmax merge at the same <= 2-ulp association bar
+    (tests/test_decode_attention.py).
+    """
+    b, s, hq, d = q.shape
+    if s != 1:
+        raise ValueError(
+            f"paged_decode_attention takes one token per slot, got S={s}"
+        )
+    ps, hkv = ck.shape[1], ck.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    if page_tables.shape[0] != b:
+        raise ValueError(
+            f"page_tables rows {page_tables.shape[0]} != batch {b}"
+        )
+    pp = page_tables.shape[1]
+    n_rep = hq // hkv
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    rows = -(-n_rep // _MIN_ROWS) * _MIN_ROWS
+    qg = q.reshape(b, hkv, n_rep, d)
+    if rows != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep), (0, 0)))
+    positions = positions.astype(jnp.int32)
+    # flattened for SMEM scalar prefetch: entry b*pp + kk
+    pt_flat = page_tables.astype(jnp.int32).reshape(-1)
+
+    def kv_index(bb, h, kk, pos_ref, pt_ref):
+        # table-space clamp: blocks past the slot's depth re-read its
+        # last visible page — an unchanged mapped block, so Pallas skips
+        # the DMA (the paged twin of the slot kernel's row clamp)
+        page = pt_ref[bb * pp + jnp.minimum(kk, pos_ref[bb] // ps)]
+        return (page, 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, pp),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, rows, d),
+                lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+            ),
+            pl.BlockSpec((None, ps, None, d), kv_index),
+            pl.BlockSpec((None, ps, None, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, rows, d),
+            lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, scale=scale_, block_k=ps, n_k=pp
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(positions, pt_flat, qg, ck, cv)
     return out[:, :, :n_rep, :].reshape(b, 1, hq, d)

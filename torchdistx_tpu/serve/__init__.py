@@ -1,22 +1,28 @@
 """Continuous-batching serving engine (TPU-native extension — the
 torchdistx reference has no inference serving surface at all).
 
-Architecture (docs/serving.md): a slot-based fixed-geometry KV cache
-(:mod:`~torchdistx_tpu.serve.kv_cache`), an FCFS scheduler with a
-max-tokens budget and per-request deadlines
+Architecture (docs/serving.md): a slot-based or PAGED fixed-geometry KV
+cache (:mod:`~torchdistx_tpu.serve.kv_cache`), a page-pool allocator +
+radix prefix index for shared-prefix reuse
+(:mod:`~torchdistx_tpu.serve.prefix_cache`), an FCFS scheduler with a
+max-tokens budget, free-page gating, and per-request deadlines
 (:mod:`~torchdistx_tpu.serve.scheduler`), a two-compiled-program engine
 (:mod:`~torchdistx_tpu.serve.engine`), and plain-dict metrics
 (:mod:`~torchdistx_tpu.serve.metrics`).
 """
 
 from .engine import ServeEngine
-from .kv_cache import SlotKVCache
+from .kv_cache import PagedKVCache, SlotKVCache
 from .metrics import Histogram, ServeMetrics
+from .prefix_cache import PagePool, RadixPrefixIndex
 from .scheduler import Request, RequestHandle, RequestResult, Scheduler
 
 __all__ = [
     "ServeEngine",
     "SlotKVCache",
+    "PagedKVCache",
+    "PagePool",
+    "RadixPrefixIndex",
     "ServeMetrics",
     "Histogram",
     "Request",

@@ -39,6 +39,22 @@ Sampling (``generation._make_slot_sampler``) reuses ``generate``'s
 top-k/top-p filters; the two jitted programs live in the model's
 ``generation._cached_jit`` store so executables are collected with the
 model.
+
+**Paged mode** (``page_size=N``): the device cache becomes per-layer
+page pools ``(num_pages, page_size, Hkv, D)`` with host page tables
+(``serve/kv_cache.py``) and a refcounted radix prefix index
+(``serve/prefix_cache.py``).  Admission additionally gates on free
+pages (a request claims only its page-aligned ``prompt +
+max_new_tokens`` footprint, minus whatever prefix the index already
+holds); prefill computes only the uncached suffix against a
+page-table gather of the slot's logical cache and scatters just the
+suffix rows back; retire decrements page refcounts and full-prompt
+pages live on in the index until LRU eviction.  The dispatch
+discipline is unchanged — prefill programs split cold (static
+``cache_pos=0``, flash-capable) / warm (traced prefix length), decode
+stays the one fused scan with the tiny int32 page table as an extra
+dynamic input — and greedy streams are bit-identical to the
+contiguous (``page_size=None``) engine (tests/test_serve.py).
 """
 
 from __future__ import annotations
@@ -59,8 +75,15 @@ from ..generation import (
 )
 from ..nn.module import functional_call
 from ..utils.profiling import timed_annotation
-from .kv_cache import SlotKVCache, write_slot
+from .kv_cache import (
+    PagedKVCache,
+    SlotKVCache,
+    paged_scatter_rows,
+    paged_view,
+    write_slot,
+)
 from .metrics import ServeMetrics
+from .prefix_cache import PagePool, RadixPrefixIndex
 from .scheduler import Request, RequestHandle, RequestResult, Scheduler
 
 __all__ = ["ServeEngine"]
@@ -123,6 +146,20 @@ class ServeEngine:
         relay-dominated regime — see docs/serving.md for choosing K);
         the default 1 is the classic one-sync-per-token step.  Each
         distinct value compiles one decode program.
+      page_size: switch the KV cache to the PAGED layout with pages of
+        this many tokens (must divide ``max_len``); ``None`` (default)
+        keeps the contiguous per-slot slab.  Paged greedy streams are
+        bit-identical to the slab engine's.
+      num_pages: pool size in paged mode.  Default
+        ``num_slots * max_len / page_size + 1`` — the slab engine's
+        footprint plus the reserved scratch page, so prefix sharing and
+        per-request footprints turn pure win into spare capacity; pass
+        less to trade capacity for HBM (admission then gates on free
+        pages) or more to keep evicted prefixes around longer.
+      prefix_cache: in paged mode, maintain the radix prefix index —
+        page-aligned shared prompt prefixes skip straight to page-table
+        assignment and prefill computes only the uncached suffix.
+        ``False`` keeps paged allocation without sharing.
       params: parameter dict override (e.g. sharded params); default
         ``dict(model.named_parameters())``.
     """
@@ -139,6 +176,9 @@ class ServeEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_tokens_in_flight: Optional[int] = None,
         decode_chunk: int = 1,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
         params: Optional[dict] = None,
     ):
         _check_sampling_args(top_k, top_p)
@@ -184,14 +224,41 @@ class ServeEngine:
             # appending a max_len bucket used to hide that ceiling AND
             # compile a program the caller never asked for.)
         self.prefill_buckets = buckets
-        self.cache = SlotKVCache(
-            model,
-            self.num_slots,
-            self.max_len,
-            placement=_kv_placement(self.params),
-        )
+        self.page_size = None if page_size is None else int(page_size)
+        self.paged = self.page_size is not None
+        if self.paged:
+            if num_pages is None:
+                # slab-equivalent HBM + the reserved scratch page
+                num_pages = (
+                    self.num_slots * (self.max_len // self.page_size) + 1
+                )
+            self.num_pages = int(num_pages)
+            self.pool = PagePool(self.num_pages)
+            self.prefix_index = (
+                RadixPrefixIndex(self.page_size) if prefix_cache else None
+            )
+            self.cache: Any = PagedKVCache(
+                model,
+                self.num_slots,
+                self.max_len,
+                self.page_size,
+                self.num_pages,
+                placement=_kv_placement(self.params),
+            )
+        else:
+            if num_pages is not None:
+                raise ValueError("num_pages requires page_size")
+            self.num_pages = None
+            self.pool = None
+            self.prefix_index = None
+            self.cache = SlotKVCache(
+                model,
+                self.num_slots,
+                self.max_len,
+                placement=_kv_placement(self.params),
+            )
         self.scheduler = Scheduler(self.num_slots, max_tokens_in_flight)
-        self.metrics = ServeMetrics(self.num_slots)
+        self.metrics = ServeMetrics(self.num_slots, num_pages=self.num_pages)
         self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
         self._last_tok = np.zeros(self.num_slots, np.int32)
         self._temps = np.zeros(self.num_slots, np.float32)
@@ -236,6 +303,18 @@ class ServeEngine:
                 "bucket in prefill_buckets (up to max_len "
                 f"{self.max_len}) or shorten the prompt"
             )
+        if self.paged:
+            need = -(-(prompt.size + max_new_tokens) // self.page_size)
+            if need > self.pool.capacity:
+                # no admission order can ever free enough pages; fail at
+                # submit with the limit named, like the bucket check above
+                raise ValueError(
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({max_new_tokens}) needs {need} pages of "
+                    f"{self.page_size} tokens, but the pool holds only "
+                    f"{self.pool.capacity} allocatable pages — raise "
+                    "num_pages or shrink the request"
+                )
         req = Request(
             rid=-1,
             prompt=prompt,
@@ -265,13 +344,17 @@ class ServeEngine:
         for req in list(self.scheduler.running):
             if req.expired(now):
                 self._finish(req, "deadline", now)
-        for req, slot in self.scheduler.admit(now):
+        for req, slot in self.scheduler.admit(
+            now, gate=self._page_gate if self.paged else None
+        ):
             self._prefill_request(req, slot)
         if self.scheduler.running:
             self._decode_step()
         self.metrics.observe_gauges(
             self.scheduler.queue_depth, self.cache.active_count
         )
+        if self.paged:
+            self.metrics.observe_pages(self.pool.in_use)
         return self.scheduler.queue_depth + len(self.scheduler.running)
 
     def run(
@@ -321,7 +404,12 @@ class ServeEngine:
     # -- the two compiled programs ---------------------------------------
 
     def _static_key(self) -> tuple:
-        return (self.num_slots, self.max_len, self.top_k, self.top_p)
+        # page_size keys the cache LAYOUT: a paged and a slab engine on
+        # the same model must never share (or co-count) programs
+        return (
+            self.num_slots, self.max_len, self.top_k, self.top_p,
+            self.page_size,
+        )
 
     def _prefill_program(self, bucket: int):
         model, sampler = self.model, self._sampler
@@ -356,12 +444,69 @@ class ServeEngine:
             donate_argnums=(1,),
         )
 
+    def _paged_prefill_program(self, bucket: int, warm: bool):
+        """Paged prefill: gather the slot's logical cache through its
+        page-table row, run the (suffix) tokens against it, sample from
+        the last real position, and scatter ONLY the suffix-bucket rows
+        back into the pools (shared prefix pages are never rewritten —
+        handoff is the table row itself).
+
+        Two program families per bucket: **cold** passes a static
+        ``cache_pos=0`` (so ``cached_attention``'s flash-prefill fast
+        path still applies on TPU, exactly as in the slab engine) and
+        **warm** a traced page-aligned prefix length (mid-cache chunked
+        prefill, the jnp path).  Bucket padding may scatter garbage rows
+        past the request's allocated pages; the table routes those onto
+        the scratch page, where nothing ever reads them.
+        """
+        model, sampler, ps = self.model, self._sampler, self.page_size
+
+        def build_warm(params, kv, pt_row, tokens, pfx_len, true_len,
+                       temp, seed):
+            view = paged_view(kv, pt_row, ps)
+            logits, view = functional_call(
+                model, params, (tokens, view, pfx_len),
+                method="forward_cached",
+            )
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1
+            )[:, 0, :]
+            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+            kv = paged_scatter_rows(kv, view, pt_row, ps, pfx_len, bucket)
+            return kv, tok[0]
+
+        def build_cold(params, kv, pt_row, tokens, true_len, temp, seed):
+            view = paged_view(kv, pt_row, ps)
+            logits, view = functional_call(
+                model, params, (tokens, view, 0), method="forward_cached"
+            )
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1
+            )[:, 0, :]
+            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+            kv = paged_scatter_rows(
+                kv, view, pt_row, ps, jnp.int32(0), bucket
+            )
+            return kv, tok[0]
+
+        # pools donated like the slab (engine rebinds before the sync)
+        return _cached_jit(
+            self.model,
+            "_serve_jit_cache",
+            ("serve_prefill_paged", bucket, warm) + self._static_key(),
+            build_warm if warm else build_cold,
+            donate_argnums=(1,),
+        )
+
     def _decode_program(self):
         """The fused K-step decode program (``_make_fused_decode``): one
         per ``(decode_chunk, eos_token)`` — both are baked into the scan
         body (the on-device finish mask needs the EOS id; the scan length
         is the chunk).  The default single-K engine therefore still holds
-        the one-decode-program invariant."""
+        the one-decode-program invariant.  Paged engines pass the page
+        tables as one extra dynamic input to the same builder (the
+        static key's ``page_size`` keeps the layouts' programs
+        apart)."""
         build = _make_fused_decode(
             self.model,
             self._sampler,
@@ -392,7 +537,68 @@ class ServeEngine:
             f"({self.prefill_buckets[-1]})"
         )
 
+    def _page_gate(self, req: Request) -> bool:
+        """Paged admission gate (run by ``Scheduler.admit`` on the FCFS
+        head): match the prompt against the prefix index, reserve the
+        shared pages (incref) plus fresh pages for the rest of the
+        request's page-aligned footprint, evicting LRU unreferenced
+        prefixes under pressure.  False (pages short even after
+        eviction) blocks the line until running requests retire; the
+        reservation is stashed on the request for ``_prefill_request``.
+        """
+        ps = self.page_size
+        hit: list = []
+        if self.prefix_index is not None:
+            hit = self.prefix_index.match(req.prompt)
+            # the suffix prefill writes view rows [P, P + bucket): shrink
+            # the hit until that span fits the slot geometry (P = 0
+            # always does — cold prefill is the no-hit case)
+            while hit and (
+                len(hit) * ps
+                + self._bucket_for(req.prompt.size - len(hit) * ps)
+                > self.max_len
+            ):
+                hit.pop()
+        need_total = -(-(req.prompt.size + req.max_new_tokens) // ps)
+        need_new = need_total - len(hit)
+        self.pool.incref(hit)  # pin before eviction can consider them
+        if self.pool.free_count < need_new and self.prefix_index is not None:
+            self.metrics.count(
+                "pages_evicted",
+                self.prefix_index.evict(
+                    self.pool, need_new - self.pool.free_count
+                ),
+            )
+        if self.pool.free_count < need_new:
+            self.pool.decref(hit)
+            return False
+        req.pages = hit + self.pool.alloc(need_new)
+        req.prefix_len = len(hit) * ps
+        return True
+
     def _prefill_request(self, req: Request, slot: int) -> None:
+        if self.paged:
+            tok = self._dispatch_prefill_paged(req, slot)
+        else:
+            tok = self._dispatch_prefill_slab(req, slot)
+        self.cache.admit(slot, req.prompt.size)
+        self._last_tok[slot] = tok
+        self._temps[slot] = req.temperature
+        self._seeds[slot] = req.seed
+        self._ntok[slot] = 1
+        self._budget[slot] = req.max_new_tokens
+        now = time.monotonic()
+        req.first_token_at = now
+        req.generated.append(tok)
+        self.metrics.count("host_syncs")
+        self.metrics.count("prefill_calls")
+        self.metrics.count("requests_admitted")
+        self.metrics.count("tokens_generated")
+        self.metrics.ttft_s.record(now - req.submitted_at)
+        self.metrics.queue_wait_s.record((req.admitted_at or now) - req.submitted_at)
+        self._check_finished(req, tok, now)
+
+    def _dispatch_prefill_slab(self, req: Request, slot: int) -> int:
         bucket = self._bucket_for(req.prompt.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : req.prompt.size] = req.prompt
@@ -412,23 +618,49 @@ class ServeEngine:
             # already hold the live output, not a deleted buffer
             self.cache.kv = kv
             tok = int(np.asarray(tok))  # host sync: the first token exists
-        self.cache.admit(slot, req.prompt.size)
-        self._last_tok[slot] = tok
-        self._temps[slot] = req.temperature
-        self._seeds[slot] = req.seed
-        self._ntok[slot] = 1
-        self._budget[slot] = req.max_new_tokens
-        now = time.monotonic()
-        req.first_token_at = now
-        req.generated.append(tok)
-        self.metrics.count("host_syncs")
-        self.metrics.count("prefill_calls")
-        self.metrics.count("requests_admitted")
         self.metrics.count("tokens_prefilled", bucket)
-        self.metrics.count("tokens_generated")
-        self.metrics.ttft_s.record(now - req.submitted_at)
-        self.metrics.queue_wait_s.record((req.admitted_at or now) - req.submitted_at)
-        self._check_finished(req, tok, now)
+        return tok
+
+    def _dispatch_prefill_paged(self, req: Request, slot: int) -> int:
+        """Consume the admission gate's page reservation: point the
+        slot's table at the chain, prefill ONLY the uncached suffix
+        (tokens past the page-aligned prefix hit), and adopt the
+        request's full-prompt pages into the prefix index."""
+        ps, pfx = self.page_size, req.prefix_len
+        suffix = req.prompt[pfx:]
+        bucket = self._bucket_for(suffix.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : suffix.size] = suffix
+        self.cache.set_table(slot, req.pages)
+        program = self._paged_prefill_program(bucket, warm=pfx > 0)
+        args = [
+            self.params,
+            self.cache.kv,
+            jnp.asarray(self.cache.page_tables[slot]),
+            jnp.asarray(padded),
+        ]
+        if pfx > 0:
+            args.append(jnp.int32(pfx))
+        args += [
+            jnp.int32(suffix.size),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.seed], jnp.int32),
+        ]
+        with timed_annotation("serve/prefill", self.metrics.prefill_s.record):
+            kv, tok = program(*args)
+            self.cache.kv = kv  # before the sync: the pools were donated
+            tok = int(np.asarray(tok))
+        # only the suffix bucket was computed — the prefix hit is the
+        # prefill compute (and token) the cache saved
+        self.metrics.count("tokens_prefilled", bucket)
+        if self.prefix_index is not None:
+            self.metrics.count("prefix_lookup_tokens", int(req.prompt.size))
+            self.metrics.count("prefix_hit_tokens", pfx)
+            n_full = req.prompt.size // ps
+            self.prefix_index.insert(
+                req.prompt[: n_full * ps], req.pages[:n_full], self.pool
+            )
+        return tok
 
     def _decode_step(self) -> None:
         """One fused decode dispatch: ``K = decode_chunk`` on-device
@@ -443,20 +675,25 @@ class ServeEngine:
         running = self.scheduler.running
         k_steps = self.decode_chunk
         program = self._decode_program()
+        args = [
+            self.params,
+            self.cache.kv,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self.cache.positions()),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+            jnp.asarray(self._ntok),
+            jnp.asarray(self._budget),
+            jnp.asarray(~self.cache.active),  # retired slots: finished
+        ]
+        if self.paged:
+            # tiny int32 dynamic input; rewritten host-side at every
+            # admit/retire, scan-invariant within the chunk
+            args.append(jnp.asarray(self.cache.page_tables))
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
         ) as timing:
-            kv, block = program(
-                self.params,
-                self.cache.kv,
-                jnp.asarray(self._last_tok),
-                jnp.asarray(self.cache.positions()),
-                jnp.asarray(self._temps),
-                jnp.asarray(self._seeds),
-                jnp.asarray(self._ntok),
-                jnp.asarray(self._budget),
-                jnp.asarray(~self.cache.active),  # retired slots: finished
-            )
+            kv, block = program(*args)
             self.cache.kv = kv  # before the sync: old slab was donated
             block = np.asarray(block)  # ONE host sync per K slot-steps
         self.metrics.count("host_syncs")
@@ -499,7 +736,13 @@ class ServeEngine:
     def _finish(self, req: Request, reason: str, now: float) -> None:
         slot = req.slot
         self.scheduler.retire(req)
-        self.cache.retire(slot)
+        self.cache.retire(slot)  # paged: also rewires the table to scratch
+        if self.paged and req.pages is not None:
+            # drop the request's references; pages the prefix index
+            # adopted live on under its own refcount until LRU eviction,
+            # the rest return to the free pool
+            self.pool.decref(req.pages)
+            req.pages = None
         self._temps[slot] = 0.0
         req.finish_reason = reason
         req.finished_at = now

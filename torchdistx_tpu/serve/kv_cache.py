@@ -1,22 +1,43 @@
-"""Slot-based, fixed-geometry KV cache for continuous-batching serving.
+"""Slot- and page-based, fixed-geometry KV caches for continuous batching.
 
-The whole cache is ONE static-shape pytree — per layer ``(k, v)`` arrays of
-shape ``(num_slots, max_len, heads, head_dim)`` (the model's own
-``init_cache(num_slots, max_len)`` layout, so ``forward_decode`` consumes
-it directly) — plus tiny host-side ``pos``/``active`` bookkeeping arrays.
-Admitting a request is a host-side slot assignment followed by an in-place
-``dynamic_update_slice`` of the prefilled slab into the slot row
-(:func:`write_slot`, traced inside the engine's prefill program); retiring
-is flipping a host bit.  Neither ever changes a device shape, so the
-compiled decode step survives any admit/retire sequence — the property the
-whole engine is built on.
+Two device layouts behind one host-bookkeeping contract:
 
-Stale-row safety: a freed slot's old K/V rows are NOT zeroed.  They are
-unreachable by construction — a slot's query attends cache rows
-``j <= pos`` only (``ops.attention.slot_cached_attention``), prefill
-overwrites rows ``[0, bucket)``, and each decode step overwrites row
-``pos`` before ``pos`` advances to make it visible — so every visible row
-was written by the request currently owning the slot.
+- :class:`SlotKVCache` — per layer ``(k, v)`` arrays of shape
+  ``(num_slots, max_len, heads, head_dim)`` (the model's own
+  ``init_cache(num_slots, max_len)`` layout).  HBM cost is
+  ``num_slots x max_len`` regardless of actual request lengths.
+- :class:`PagedKVCache` — per layer ``(k, v)`` **page pools** of shape
+  ``(num_pages, page_size, heads, head_dim)`` (``init_cache(num_pages,
+  page_size)``), plus host-side per-slot page tables padded to
+  ``max_len / page_size`` entries.  A slot's logical cache is the
+  concatenation of the pages its table row names; requests claim only
+  the pages their ``prompt + max_new_tokens`` footprint needs, and
+  page-aligned shared prefixes are handed over by **table rewrite**
+  (two tables naming the same page), never by copying KV.
+
+In both, admitting/retiring a request changes only tiny dynamic inputs
+(positions, a table row, a host bit) — never a device shape — so the
+compiled prefill/decode programs survive any admit/retire sequence: the
+property the whole engine is built on.
+
+Stale-row safety (paged): a freed page's old K/V rows are NOT zeroed.
+They are unreachable by construction — a page is freed only when its
+refcount reaches zero, i.e. no live page table references it (retiring a
+slot rewires its whole table row to the reserved scratch page, so even
+the frozen post-finish decode writes of a fused chunk land harmlessly in
+scratch) and the prefix index no longer holds it; while the index DOES
+hold a page, its refcount keeps it out of the free list, so an allocated
+page can never be reached through some other request's stale table.
+Within a live slot the slab-era argument still applies row-wise: a query
+attends view rows ``j <= pos`` only, prefill overwrites the suffix rows
+it claims, and each decode step overwrites row ``pos`` before ``pos``
+advances to make it visible — every *visible* row of every *referenced*
+page was written by a request entitled to it (the owning request, or the
+request that computed the shared prefix).  Garbage beyond — bucket
+padding, scratch-page scribbles, stale rows of reused pages — is masked
+to exactly-zero probability and never perturbs a stream (regression:
+``tests/test_prefix_cache.py`` reuses a retired request's pages and pins
+bit-identity against a fresh engine).
 """
 
 from __future__ import annotations
@@ -26,9 +47,18 @@ from typing import Any, List, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["SlotKVCache", "write_slot"]
+from .prefix_cache import SCRATCH_PAGE
+
+__all__ = [
+    "SlotKVCache",
+    "PagedKVCache",
+    "write_slot",
+    "paged_view",
+    "paged_scatter_rows",
+]
 
 
 def write_slot(kv: Any, slab: Any, slot) -> Any:
@@ -56,47 +86,75 @@ def write_slot(kv: Any, slab: Any, slot) -> Any:
     return out
 
 
-class SlotKVCache:
-    """Host bookkeeping around the device cache pytree.
+def paged_view(kv: Any, table_row: jax.Array, page_size: int) -> Any:
+    """Gather one slot's logical cache from the page pools.
+
+    ``kv``: list per layer of ``(k, v)`` pools, shape (num_pages,
+    page_size, H, D).  ``table_row``: (pages_per_slot,) int32 page ids
+    (unassigned entries name the scratch page — their rows are garbage
+    but sit beyond the visibility mask).  Returns the model-facing view:
+    list per layer of ``(k, v)`` with shape (1, max_len, H, D), where
+    ``max_len = pages_per_slot * page_size``.  A pure gather — the pools
+    are read, never copied page-to-page.
+    """
+    rows = (
+        table_row[:, None] * page_size + jnp.arange(page_size)[None, :]
+    ).reshape(-1)
+    out: List[tuple] = []
+    for k, v in kv:
+        fk = k.reshape(-1, *k.shape[2:])
+        fv = v.reshape(-1, *v.shape[2:])
+        out.append((fk[rows][None], fv[rows][None]))
+    return out
+
+
+def paged_scatter_rows(
+    kv: Any, view: Any, table_row: jax.Array, page_size: int, start, length: int
+) -> Any:
+    """Write ``length`` freshly computed rows of an updated slot view
+    (starting at traced row ``start``) back into the page pools through
+    the slot's table row.  Only the suffix span moves — shared prefix
+    pages are never rewritten.  ``length`` is static (the prefill
+    bucket); rows landing past the slot's allocated pages route to the
+    scratch page (bucket padding) and are never visible."""
+    offs = start + jnp.arange(length)
+    rows = table_row[offs // page_size] * page_size + offs % page_size
+    out: List[tuple] = []
+    for (k, v), (wk, wv) in zip(kv, view):
+        seg_k = lax.dynamic_slice_in_dim(wk[0], start, length, axis=0)
+        seg_v = lax.dynamic_slice_in_dim(wv[0], start, length, axis=0)
+        fk = k.reshape(-1, *k.shape[2:]).at[rows].set(seg_k.astype(k.dtype))
+        fv = v.reshape(-1, *v.shape[2:]).at[rows].set(seg_v.astype(v.dtype))
+        out.append((fk.reshape(k.shape), fv.reshape(v.shape)))
+    return out
+
+
+class _HostBookkeeping:
+    """The pos/active arrays both cache layouts share.
 
     ``pos[slot]`` is the number of tokens currently cached for the slot
     (equivalently: the row the slot's NEXT token will be written to);
-    ``active[slot]`` marks slots owned by a running request.  Both live as
-    host numpy — they ride into the compiled programs as tiny dynamic
+    ``active[slot]`` marks slots owned by a running request.  Both live
+    as host numpy — they ride into the compiled programs as tiny dynamic
     inputs, never as static values.
     """
 
-    def __init__(
-        self,
-        model: Any,
-        num_slots: int,
-        max_len: int,
-        placement: Optional[Any] = None,
-    ):
+    num_slots: int
+    max_len: int
+
+    def _init_host(self, num_slots: int, max_len: int) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
-        # COMMIT the fresh cache to its placement: the engine's programs
-        # return committed arrays, and an uncommitted first-call cache
-        # would flip the jit signature (committed-ness is part of it) on
-        # the second call — one silent recompile per program, the exact
-        # class the two-program discipline exists to prevent.  The
-        # placement must agree with the params' devices (mixed committed
-        # device sets are a jit error), so the engine derives it from the
-        # params (replicated over their mesh when they are sharded).
-        self.kv = jax.device_put(
-            model.init_cache(self.num_slots, self.max_len),
-            placement if placement is not None else jax.devices()[0],
-        )
         self.pos = np.zeros(self.num_slots, np.int32)
         self.active = np.zeros(self.num_slots, bool)
 
     def admit(self, slot: int, true_len: int) -> None:
         """Claim ``slot`` for a freshly prefilled request of ``true_len``
-        prompt tokens (the engine's prefill program writes the slab)."""
+        prompt tokens (the engine's prefill program writes the KV)."""
         if self.active[slot]:
             raise ValueError(f"slot {slot} is already active")
         if not 0 < true_len <= self.max_len:
@@ -137,3 +195,94 @@ class SlotKVCache:
             for pair in self.kv
             for a in pair
         )
+
+
+class SlotKVCache(_HostBookkeeping):
+    """Host bookkeeping around the contiguous per-slot device cache."""
+
+    def __init__(
+        self,
+        model: Any,
+        num_slots: int,
+        max_len: int,
+        placement: Optional[Any] = None,
+    ):
+        self._init_host(num_slots, max_len)
+        # COMMIT the fresh cache to its placement: the engine's programs
+        # return committed arrays, and an uncommitted first-call cache
+        # would flip the jit signature (committed-ness is part of it) on
+        # the second call — one silent recompile per program, the exact
+        # class the two-program discipline exists to prevent.  The
+        # placement must agree with the params' devices (mixed committed
+        # device sets are a jit error), so the engine derives it from the
+        # params (replicated over their mesh when they are sharded).
+        self.kv = jax.device_put(
+            model.init_cache(self.num_slots, self.max_len),
+            placement if placement is not None else jax.devices()[0],
+        )
+
+
+class PagedKVCache(_HostBookkeeping):
+    """Host bookkeeping around the page-pool device cache.
+
+    The device arrays are per-layer ``(k, v)`` pools of shape
+    ``(num_pages, page_size, Hkv, D)``; ``page_tables`` maps each slot's
+    logical rows onto pages (``pages_per_slot = max_len / page_size``
+    int32 entries per slot, unassigned entries naming the scratch page).
+    The table rides into the compiled programs as a tiny dynamic int32
+    array — rewriting it (admission, prefix handoff, retirement) never
+    touches a device shape.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        num_slots: int,
+        max_len: int,
+        page_size: int,
+        num_pages: int,
+        placement: Optional[Any] = None,
+    ):
+        self._init_host(num_slots, max_len)
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size}"
+            )
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (scratch + one usable), got "
+                f"{num_pages}"
+            )
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_slot = self.max_len // self.page_size
+        # same commit-at-construction rationale as SlotKVCache
+        self.kv = jax.device_put(
+            model.init_cache(self.num_pages, self.page_size),
+            placement if placement is not None else jax.devices()[0],
+        )
+        self.page_tables = np.full(
+            (self.num_slots, self.pages_per_slot), SCRATCH_PAGE, np.int32
+        )
+
+    def set_table(self, slot: int, pages: List[int]) -> None:
+        """Point ``slot`` at its page chain (prefix-order); entries past
+        the chain name the scratch page."""
+        if len(pages) > self.pages_per_slot:
+            raise ValueError(
+                f"{len(pages)} pages exceed pages_per_slot "
+                f"{self.pages_per_slot}"
+            )
+        self.page_tables[slot, :] = SCRATCH_PAGE
+        self.page_tables[slot, : len(pages)] = pages
+
+    def retire(self, slot: int) -> None:
+        """Free the slot AND rewire its table to the scratch page: a
+        fused chunk keeps rewriting a finished slot's frozen row on
+        device, and after the pages are freed (and possibly reallocated)
+        those writes must land somewhere no live request reads."""
+        super().retire(slot)
+        self.page_tables[slot, :] = SCRATCH_PAGE

@@ -73,11 +73,17 @@ class ServeMetrics:
     dispatch), ``decode_dispatches`` (compiled-program launches),
     ``host_syncs`` (device->host materializations: one per prefill and
     one per decode dispatch — with ``decode_chunk=K`` roughly 1/K per
-    token, THE number the fused decode loop exists to shrink), and
+    token, THE number the fused decode loop exists to shrink),
     ``masked_slot_steps`` (slot-steps the on-device finish mask threw
     away because a request finished mid-chunk: the wasted-work side of
-    the host-sync tradeoff).
-    Gauges: ``queue_depth``, ``active_slots``.
+    the host-sync tradeoff), and the prefix-cache set —
+    ``prefix_lookup_tokens`` / ``prefix_hit_tokens`` (prompt tokens
+    looked up in the radix index vs served from it; their ratio is the
+    derived ``prefix_hit_rate``) and ``pages_evicted`` (LRU evictions
+    from the prefix index under pool pressure).
+    Gauges: ``queue_depth``, ``active_slots``; paged engines add
+    ``pages_in_use`` / ``pages_in_use_hwm`` (current and high-water
+    allocated pages) and ``num_pages``.
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``slot_occupancy`` (active /
     total slots, sampled per decode dispatch), ``prefill_s`` /
@@ -87,8 +93,19 @@ class ServeMetrics:
     over the chunk).
     """
 
-    def __init__(self, num_slots: int):
+    _HISTOGRAMS = (
+        "ttft_s",
+        "e2e_latency_s",
+        "queue_wait_s",
+        "slot_occupancy",
+        "prefill_s",
+        "decode_s",
+        "decode_token_s",
+    )
+
+    def __init__(self, num_slots: int, num_pages: Optional[int] = None):
         self.num_slots = int(num_slots)
+        self.num_pages = num_pages if num_pages is None else int(num_pages)
         self.started_at = time.monotonic()
         self.counters: Dict[str, int] = {
             "requests_submitted": 0,
@@ -103,9 +120,14 @@ class ServeMetrics:
             "decode_dispatches": 0,
             "host_syncs": 0,
             "masked_slot_steps": 0,
+            "prefix_lookup_tokens": 0,
+            "prefix_hit_tokens": 0,
+            "pages_evicted": 0,
         }
         self.queue_depth = 0
         self.active_slots = 0
+        self.pages_in_use = 0
+        self.pages_in_use_hwm = 0
         self.ttft_s = Histogram()
         self.e2e_latency_s = Histogram()
         self.queue_wait_s = Histogram()
@@ -122,44 +144,79 @@ class ServeMetrics:
         self.active_slots = active_slots
         self.slot_occupancy.record(active_slots / max(1, self.num_slots))
 
-    def snapshot(self) -> dict:
-        """One flat, JSON-serializable dict of everything above plus
-        derived rates (``decode_tokens_per_sec`` over decode-dispatch
-        time — the engine's steady-state throughput — and
-        ``wall_tokens_per_sec`` over the metrics lifetime)."""
-        out: dict = dict(self.counters)
-        out["queue_depth"] = self.queue_depth
-        out["active_slots"] = self.active_slots
-        out["num_slots"] = self.num_slots
-        for name in (
-            "ttft_s",
-            "e2e_latency_s",
-            "queue_wait_s",
-            "slot_occupancy",
-            "prefill_s",
-            "decode_s",
-            "decode_token_s",
-        ):
-            for k, v in getattr(self, name).snapshot().items():
-                out[f"{name}_{k}"] = v
+    def observe_pages(self, in_use: int) -> None:
+        """Paged engines only: current allocated pages.  The high-water
+        mark accumulates HERE, over this metrics object's lifetime — so
+        a reset (e.g. between bench passes) starts a fresh peak instead
+        of inheriting the pool's engine-lifetime one."""
+        self.pages_in_use = in_use
+        self.pages_in_use_hwm = max(self.pages_in_use_hwm, in_use)
+
+    def to_json(self) -> dict:
+        """The one structured, JSON-serializable schema tests, bench, and
+        CI all parse: ``{"counters", "gauges", "histograms", "derived"}``
+        — counters and gauges verbatim, one summary dict per histogram
+        (``count/mean/p50/p95/max``), and the derived rates.
+        ``scripts/bench_serve.py`` embeds this whole object per phase
+        instead of hand-picking fields."""
+        gauges: dict = {
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "num_slots": self.num_slots,
+        }
+        if self.num_pages is not None:
+            gauges["num_pages"] = self.num_pages
+            gauges["pages_in_use"] = self.pages_in_use
+            gauges["pages_in_use_hwm"] = self.pages_in_use_hwm
         wall = time.monotonic() - self.started_at
-        out["wall_s"] = wall
         # decode-only tokens over decode-only time: prefill's sampled
         # token rides a prefill dispatch, so counting it here would
         # inflate short-generation throughput
         decode_time = self.decode_s.total
-        out["decode_tokens_per_sec"] = (
-            self.counters["tokens_decoded"] / decode_time
-            if decode_time > 0
-            else None
-        )
-        out["wall_tokens_per_sec"] = (
-            self.counters["tokens_generated"] / wall if wall > 0 else None
-        )
-        # the fused-decode headline: device->host round trips per emitted
-        # token (1 + 1/max_new at K=1, ~1/K once chunking amortizes them)
         tokens = self.counters["tokens_generated"]
-        out["syncs_per_token"] = (
-            self.counters["host_syncs"] / tokens if tokens > 0 else None
-        )
+        lookups = self.counters["prefix_lookup_tokens"]
+        derived = {
+            "wall_s": wall,
+            "decode_tokens_per_sec": (
+                self.counters["tokens_decoded"] / decode_time
+                if decode_time > 0
+                else None
+            ),
+            "wall_tokens_per_sec": tokens / wall if wall > 0 else None,
+            # the fused-decode headline: device->host round trips per
+            # emitted token (1 + 1/max_new at K=1, ~1/K once chunking
+            # amortizes them)
+            "syncs_per_token": (
+                self.counters["host_syncs"] / tokens if tokens > 0 else None
+            ),
+            # the prefix-cache headline: prompt tokens served from cached
+            # pages instead of recomputed
+            "prefix_hit_rate": (
+                self.counters["prefix_hit_tokens"] / lookups
+                if lookups > 0
+                else None
+            ),
+        }
+        return {
+            "counters": dict(self.counters),
+            "gauges": gauges,
+            "histograms": {
+                name: getattr(self, name).snapshot()
+                for name in self._HISTOGRAMS
+            },
+            "derived": derived,
+        }
+
+    def snapshot(self) -> dict:
+        """``to_json`` flattened to one dict (counters and gauges
+        verbatim, ``<hist>_<stat>`` per histogram entry, derived rates) —
+        the legacy record shape, kept as a strict projection of
+        ``to_json`` so the two can never disagree."""
+        j = self.to_json()
+        out: dict = dict(j["counters"])
+        out.update(j["gauges"])
+        for name, summary in j["histograms"].items():
+            for k, v in summary.items():
+                out[f"{name}_{k}"] = v
+        out.update(j["derived"])
         return out

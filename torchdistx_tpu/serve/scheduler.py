@@ -65,6 +65,9 @@ class Request:
     slot: Optional[int] = None
     generated: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None
+    # -- paged-KV reservation (engine's admission gate stashes these) ----
+    pages: Optional[List[int]] = None  # page chain, prefix order
+    prefix_len: int = 0  # page-aligned tokens served from the prefix cache
 
     @property
     def cost(self) -> int:
@@ -169,12 +172,15 @@ class Scheduler:
             r.finished_at = now
         return expired
 
-    def admit(self, now: float) -> List[Tuple[Request, int]]:
+    def admit(self, now: float, gate=None) -> List[Tuple[Request, int]]:
         """Admit queued requests FCFS while a slot is free and the token
         budget holds.  Strict FCFS: a blocked head blocks the line (no
-        skip-ahead starvation of big requests).  Returns (request, slot)
-        pairs; the engine prefills each and then confirms with the
-        KV-cache bookkeeping."""
+        skip-ahead starvation of big requests).  ``gate`` is an optional
+        extra admission predicate over the head request — the paged
+        engine's free-pages check (which reserves pages as a side
+        effect); a False return blocks the line like the token budget
+        does.  Returns (request, slot) pairs; the engine prefills each
+        and then confirms with the KV-cache bookkeeping."""
         admitted = []
         while self._queue and self._free_slots:
             head = self._queue[0]
@@ -185,6 +191,8 @@ class Scheduler:
                 and self._running
             ):
                 break  # budget holds until running requests retire
+            if gate is not None and not gate(head):
+                break  # e.g. pages free up only when running requests end
             self._queue.popleft()
             slot = self._free_slots.pop()
             head.slot = slot
