@@ -60,20 +60,31 @@ def _train_throughput():
         warm_to_steady_state,
     )
 
+    from torchdistx_tpu.obs import RecompileWatcher, recompile_scope
+
     n_steps = 20
     w = build_train_workload(n_steps)
     run, carry = w["run"], w["carry"]
 
     # warm to the layout fixpoint — a single warm call would time the
     # donated-carry recompile, round-2's measurement bug (see
-    # utils.benchmarks.warm_to_steady_state)
+    # utils.benchmarks.warm_to_steady_state).  The recompile watcher
+    # turns that from a timing inference into counters in the record:
+    # warm-up compiles under "warmup", and the timed window's compiles
+    # under "timed_window" (expected ZERO when warm_converged).
+    watcher = RecompileWatcher()
     carry, warm_times, warm_converged = warm_to_steady_state(
-        run, carry, sync=lambda losses: float(np.asarray(losses[-1]))
+        run,
+        carry,
+        sync=lambda losses: float(np.asarray(losses[-1])),
+        watcher=watcher,
+        label="warmup",
     )
 
     t0 = _time.perf_counter()
-    carry, losses = run(carry)
-    final_loss = float(np.asarray(losses[-1]))  # forces the whole chain
+    with recompile_scope("timed_window"):
+        carry, losses = run(carry)
+        final_loss = float(np.asarray(losses[-1]))  # forces the whole chain
     dt = _time.perf_counter() - t0
 
     toks = n_steps * w["batch"] * w["seq"]
@@ -88,6 +99,9 @@ def _train_throughput():
         "train_warm_calls_s": [round(t, 2) for t in warm_times],
         # False would mean the timed window may still contain a recompile
         "train_warm_converged": warm_converged,
+        # the watcher's counters back that flag with numbers: compiles
+        # attributed to warm-up vs the timed window (window must be 0)
+        "train_recompile": watcher.snapshot(),
         "train_window_s": round(dt, 3),
         "train_final_loss": round(final_loss, 4)
         if math.isfinite(final_loss)

@@ -12,7 +12,15 @@ full metrics (``ServeMetrics.to_json()``) plus the warm prefix hit-rate
 and pages-in-use high water, and flags ``error`` when the inequality
 fails (so ``TDX_SERVE_STRICT`` CI catches a broken prefix cache).  Each
 phase embeds ``engine.metrics.to_json()`` verbatim under ``"metrics"`` —
-one schema for tests, bench, and CI to parse.
+one schema for tests, bench, and CI to parse — plus the recompile
+watcher's counters (``recompile_warmup`` / ``recompile_measure``: XLA
+compiles attributed serve/prefill vs serve/decode; the measured window
+is expected to compile NOTHING, and ``measure_compiles`` in the summary
+says so per phase).  With ``TDX_SERVE_TRACE_DIR`` set, each phase also
+writes a Perfetto host trace (per-request lifecycle tracks included)
+and a Prometheus exposition snapshot there, paths embedded in the
+record (``trace_path`` / ``metrics_prom_path`` — what the nightly
+observability smoke validates).
 
 Same output contract as bench.py: a FULL parseable JSON record is the
 LAST stdout line after EVERY phase, baseline included — so a relay that
@@ -94,6 +102,16 @@ def _phase_summary(rec: dict) -> dict:
         "decode_token_s_p50": (hists.get("decode_token_s") or {}).get("p50"),
         "decode_token_s_p95": (hists.get("decode_token_s") or {}).get("p95"),
         "masked_slot_steps": counters.get("masked_slot_steps"),
+        # compiles inside the measured window (recompile watcher):
+        # anything nonzero means the phase's timings include XLA
+        # compiles.  available=False means the jax.monitoring hook is
+        # missing and the count is UNKNOWN — surface null, never a
+        # clean-looking 0 (the watcher's snapshot contract)
+        "measure_compiles": (
+            (rec.get("recompile_measure") or {}).get("compiles_total")
+            if (rec.get("recompile_measure") or {}).get("available")
+            else None
+        ),
         "error": rec.get("error"),
     }
     if "warm" in rec:  # the prefix-share phase
@@ -246,6 +264,12 @@ def _phase_setup(args, **extra) -> tuple:
     plat = os.environ.get("TDX_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    if os.environ.get("TDX_SERVE_TRACE_DIR"):
+        # host tracing for this phase: spans land in the per-phase
+        # Perfetto file _dump_obs writes at the end of the child
+        from torchdistx_tpu import obs
+
+        obs.enable_tracing()
     k_chunk = int(os.environ.get("TDX_SERVE_CHUNK", "1"))
     name = os.environ.get("TDX_SERVE_MODEL", "llama_1b")
     record: dict = {
@@ -259,6 +283,36 @@ def _phase_setup(args, **extra) -> tuple:
         **extra,
     }
     return record, name, k_chunk, plat
+
+
+def _dump_obs(record: dict, engine, tag: str) -> None:
+    """Per-phase observability artifacts (opt-in via
+    ``TDX_SERVE_TRACE_DIR``): a Perfetto trace of the phase — tracer
+    spans + one lifecycle track per finished request — and the
+    Prometheus exposition of the phase's final metrics.  Paths and a
+    small summary are embedded in the phase record (additive keys;
+    existing consumers parse the last line unchanged)."""
+    out_dir = os.environ.get("TDX_SERVE_TRACE_DIR")
+    if not out_dir:
+        return
+    from torchdistx_tpu import obs
+
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = os.path.join(out_dir, f"{tag}_trace.json")
+    engine.dump_trace(trace_path)
+    finished = engine.finished_requests()
+    record["trace_path"] = trace_path
+    record["trace_summary"] = {
+        "requests": len(finished),
+        "lifecycle_events": sum(len(r.events) for r in finished),
+        "tracer_spans": len(obs.get_tracer().events()),
+    }
+    registry = obs.MetricsRegistry()
+    registry.register_collector(engine.metrics.collector())
+    prom_path = os.path.join(out_dir, f"{tag}_metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(registry.render())
+    record["metrics_prom_path"] = prom_path
 
 
 def _build_model(name: str, plat):
@@ -280,8 +334,13 @@ def _child(args) -> None:
 
     import numpy as np
 
+    from torchdistx_tpu import obs
     from torchdistx_tpu.serve import ServeEngine
 
+    # counts every XLA compile, attributed serve/prefill vs serve/decode
+    # by the engine's timed_annotation regions; warm-up compiles and
+    # steady-state compiles (expected: zero) are reported separately
+    watcher = obs.RecompileWatcher()
     try:
         model = _build_model(name, plat)
         limit = model.cfg.max_seq_len
@@ -320,6 +379,8 @@ def _child(args) -> None:
             if plen < b:
                 break  # larger buckets unreachable by this workload
         engine.metrics = ServeMetrics(engine.num_slots)
+        record["recompile_warmup"] = watcher.snapshot()
+        watcher.reset()  # the measured window must compile NOTHING
 
         t0 = time.perf_counter()
         results = engine.run(
@@ -336,6 +397,9 @@ def _child(args) -> None:
         wall = time.perf_counter() - t0
 
         record["metrics"] = engine.metrics.to_json()
+        # compiles DURING the measured window: nonzero means the warm-up
+        # missed a program and the timings above include XLA compiles
+        record["recompile_measure"] = watcher.snapshot()
         record.update(
             max_len=max_len,
             drain_wall_s=round(wall, 3),
@@ -344,6 +408,7 @@ def _child(args) -> None:
             finish_reasons=sorted({r.finish_reason for r in results}),
             kv_cache_gb=round(engine.cache.nbytes / 1e9, 3),
         )
+        _dump_obs(record, engine, f"k{k_chunk}")
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -361,9 +426,11 @@ def _child_prefix(args) -> None:
 
     import numpy as np
 
+    from torchdistx_tpu import obs
     from torchdistx_tpu.serve import ServeEngine
     from torchdistx_tpu.serve.metrics import ServeMetrics
 
+    watcher = obs.RecompileWatcher()
     try:
         model = _build_model(name, plat)
         limit = model.cfg.max_seq_len
@@ -424,9 +491,12 @@ def _child_prefix(args) -> None:
         engine.run([dict(r) for r in burst])
         engine.run([dict(r) for r in burst])
         engine.prefix_index.evict(engine.pool, engine.pool.capacity)
+        record["recompile_warmup"] = watcher.snapshot()
+        watcher.reset()  # both timed passes must compile nothing
 
         record["cold"] = run_pass()
         record["warm"] = run_pass()
+        record["recompile_measure"] = watcher.snapshot()
         cold_m, warm_m = record["cold"]["metrics"], record["warm"]["metrics"]
         record["tokens_prefilled_cold"] = cold_m["counters"][
             "tokens_prefilled"
@@ -450,6 +520,7 @@ def _child_prefix(args) -> None:
         # the warm pass's full metrics double as the phase metrics for
         # the shared summary schema
         record["metrics"] = warm_m
+        _dump_obs(record, engine, "prefix_share")
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))

@@ -1,0 +1,130 @@
+"""Validate the observability artifacts of a bench_serve run (CI smoke).
+
+Given the bench record (``BENCH_SERVE_CPU.json`` or a file holding the
+last stdout line), for every phase that embedded observability paths:
+
+- the Perfetto trace must ``json.load`` and satisfy the catapult
+  ``traceEvents`` schema (list of events with ``name``/``ph``; complete
+  events carry numeric ``ts``/``dur``; at least one per-request
+  lifecycle track is present);
+- the Prometheus exposition must round-trip through the stdlib line
+  parser (``obs.parse_prometheus``) with every serve counter EQUAL to
+  the same counter in the phase's embedded ``metrics`` JSON — the
+  exposition is a projection of ``to_json()``, and this is the gate
+  that keeps the two schemas from drifting apart.
+
+Exit nonzero (with a reason per failure) when anything is off; print a
+one-line OK summary otherwise.  Stdlib + torchdistx_tpu.obs only.
+
+Usage:  python scripts/check_obs_artifacts.py BENCH_SERVE_CPU.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchdistx_tpu.obs import parse_prometheus  # noqa: E402
+
+
+def check_trace(path: str, errors: list) -> int:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: unreadable trace JSON: {e}")
+        return 0
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        errors.append(f"{path}: no traceEvents list")
+        return 0
+    request_spans = 0
+    for ev in evs:
+        if not isinstance(ev, dict) or "ph" not in ev or "name" not in ev:
+            errors.append(f"{path}: malformed event {ev!r:.120}")
+            return 0
+        if ev["ph"] == "X":
+            if not (
+                isinstance(ev.get("ts"), (int, float))
+                and isinstance(ev.get("dur"), (int, float))
+                and ev["dur"] >= 0
+            ):
+                errors.append(f"{path}: X event without ts/dur: {ev!r:.120}")
+                return 0
+            if ev.get("cat") == "request":
+                request_spans += 1
+    if request_spans == 0:
+        errors.append(f"{path}: no per-request lifecycle spans")
+    return len(evs)
+
+
+def check_prom(path: str, metrics_json: dict, errors: list) -> int:
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        errors.append(f"{path}: unreadable exposition: {e}")
+        return 0
+    try:
+        parsed = parse_prometheus(text)
+    except ValueError as e:
+        errors.append(f"{path}: exposition does not parse: {e}")
+        return 0
+    samples = parsed["samples"]
+    counters = (metrics_json or {}).get("counters") or {}
+    if not counters:
+        errors.append(f"{path}: phase record embeds no metrics counters")
+        return 0
+    for name, v in counters.items():
+        key = (f"tdx_serve_{name}_total", ())
+        if key not in samples:
+            errors.append(f"{path}: missing exposition sample {key[0]}")
+        elif samples[key] != v:
+            errors.append(
+                f"{path}: {key[0]} is {samples[key]} in exposition but "
+                f"{v} in metrics JSON — the projection drifted"
+            )
+    return len(samples)
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        raise SystemExit(__doc__)
+    with open(sys.argv[1]) as f:
+        record = json.load(f)
+    errors: list = []
+    checked = 0
+    for name, phase in (record.get("phases") or {}).items():
+        if "error" in phase:
+            errors.append(f"phase {name}: {phase['error']}")
+            continue
+        if "trace_path" not in phase:
+            continue  # phase ran without TDX_SERVE_TRACE_DIR
+        checked += 1
+        n_events = check_trace(phase["trace_path"], errors)
+        n_samples = check_prom(
+            phase.get("metrics_prom_path", ""),
+            phase.get("metrics"),
+            errors,
+        )
+        print(
+            f"phase {name}: {n_events} trace events, "
+            f"{n_samples} exposition samples"
+        )
+    if checked == 0:
+        errors.append(
+            "no phase carried observability artifacts — was "
+            "TDX_SERVE_TRACE_DIR set for the bench run?"
+        )
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"observability artifacts OK ({checked} phase(s))")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,7 @@ Flagship features (reference README.md:15-18):
 
 __version__ = "0.5.0.dev0"
 
-from . import nn, ops, serve
+from . import nn, obs, ops, serve
 from .generation import generate
 from .deferred_init import (
     can_materialize,

@@ -476,12 +476,17 @@ class RecordingSession:
             )
         if mode == "auto":
             mode = self._choose_replay_mode(sched)
-        if mode == "chunked":
-            self._replay_chunked(sched, env, emit, ambient)
-        else:
-            for nid in sched:
-                outs = self.closures[nid].call(env, ambient)
-                emit(nid, outs)
+        from .obs.trace import get_tracer
+
+        with get_tracer().span(
+            f"replay/{mode}", cat="replay", ops=len(sched)
+        ):
+            if mode == "chunked":
+                self._replay_chunked(sched, env, emit, ambient)
+            else:
+                for nid in sched:
+                    outs = self.closures[nid].call(env, ambient)
+                    emit(nid, outs)
 
         for nid in sched:
             released = self.graph.mark_materialized(nid)
@@ -707,7 +712,17 @@ class RecordingSession:
             entry = jax.jit(chunk_fn)
             self._chunk_cache[sig] = entry
 
-        flat = entry(ext_vals, dyn_vals)
+        # one span + recompile-attribution scope per chunk dispatch: a
+        # replay whose chunk cache stops hitting shows up as compiles
+        # under "replay/chunk" in any installed RecompileWatcher, and
+        # the Perfetto trace shows one span per dispatch
+        from .obs.recompile import recompile_scope
+        from .obs.trace import get_tracer
+
+        with get_tracer().span(
+            "replay/chunk", cat="replay", ops=len(chunk)
+        ), recompile_scope("replay/chunk"):
+            flat = entry(ext_vals, dyn_vals)
         pos = 0
         for nid, c in zip(chunk, closures):
             emit(nid, flat[pos : pos + c.n_outputs])

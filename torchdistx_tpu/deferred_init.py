@@ -155,21 +155,30 @@ def materialize_module(
             )
         by_session.setdefault(fake._session, []).append(i)
 
+    from .obs.trace import get_tracer
+
     results: dict[int, Any] = {}
-    for session, idxs in by_session.items():
-        targets, shardings, devices = [], [], []
-        for i in idxs:
-            _, _, path, fake = entries[i]
-            sharding = sharding_rule(path, fake) if sharding_rule else None
-            device = None
-            if sharding is None:
-                device = _resolve_claim(fake)
-            targets.append((fake._node, fake._out_idx))
-            shardings.append(sharding)
-            devices.append(device)
-        outs = session.materialize_many(targets, shardings, devices)
-        for i, out in zip(idxs, outs):
-            results[i] = out
+    # one host span per module materialization; the replay executor adds
+    # nested replay/{eager,chunked} (+ per-chunk) spans underneath
+    with get_tracer().span(
+        "materialize_module", cat="replay", tensors=len(entries)
+    ):
+        for session, idxs in by_session.items():
+            targets, shardings, devices = [], [], []
+            for i in idxs:
+                _, _, path, fake = entries[i]
+                sharding = (
+                    sharding_rule(path, fake) if sharding_rule else None
+                )
+                device = None
+                if sharding is None:
+                    device = _resolve_claim(fake)
+                targets.append((fake._node, fake._out_idx))
+                shardings.append(sharding)
+                devices.append(device)
+            outs = session.materialize_many(targets, shardings, devices)
+            for i, out in zip(idxs, outs):
+                results[i] = out
 
     for i, (store, name, _, _) in enumerate(entries):
         store[name] = results[i]

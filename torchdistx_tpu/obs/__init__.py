@@ -1,0 +1,58 @@
+"""Unified tracing & telemetry (net-new vs the reference — SURVEY §5.1
+documents that torchdistx ships no tracing or metrics at all).
+
+Three zero-dependency layers, instrumented end-to-end through the serve
+engine, trainer, and deferred-init replay (docs/observability.md):
+
+- :mod:`~torchdistx_tpu.obs.trace` — host-side span tracer with
+  Chrome-trace (Perfetto) JSON export and a JSONL structured-event
+  sink; per-request serving lifecycle tracks via
+  :func:`request_trace_events`.
+- :mod:`~torchdistx_tpu.obs.metrics` — metrics registry (counters /
+  gauges / summaries with labels) with Prometheus text exposition, a
+  stdlib round-trip parser, and an optional ``http.server``
+  ``/metrics`` endpoint.
+- :mod:`~torchdistx_tpu.obs.recompile` — ``jax.monitoring``-backed
+  recompile watcher counting and attributing XLA compiles per scope
+  (the donated-carry double compile from CLAUDE.md becomes a named
+  counter instead of a timing artifact).
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    MetricFamily,
+    MetricsRegistry,
+    Summary,
+    default_registry,
+    parse_prometheus,
+    render_prometheus,
+    start_metrics_server,
+)
+from .recompile import RecompileWatcher, recompile_scope
+from .trace import (
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+    request_trace_events,
+)
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "enable_tracing",
+    "disable_tracing",
+    "request_trace_events",
+    "MetricFamily",
+    "Counter",
+    "Gauge",
+    "Summary",
+    "MetricsRegistry",
+    "default_registry",
+    "render_prometheus",
+    "parse_prometheus",
+    "start_metrics_server",
+    "RecompileWatcher",
+    "recompile_scope",
+]

@@ -1,0 +1,301 @@
+"""Host-side span tracer with Chrome-trace (Perfetto) export + JSONL sink.
+
+The host half of the observability story: ``jax.profiler`` traces show
+the XLA timeline, but every perf regression so far (the donated-carry
+recompile, relay-dominated dispatch) lived in HOST control flow — the
+engine's dispatch loop, the scheduler, the replay executor.  This tracer
+records those host spans with ``time.monotonic`` timestamps (the same
+clock the serving ``Request`` lifecycle uses, so per-request spans and
+``ServeMetrics`` histograms derive from identical numbers) and exports a
+valid catapult ``traceEvents`` JSON that Perfetto / ``chrome://tracing``
+opens directly — *alongside*, never replacing, a ``jax.profiler`` trace.
+
+Zero-dependency and near-zero-cost when disabled: the module-level
+tracer starts disabled, ``span()`` on a disabled tracer is a no-op
+context manager, and nothing here ever touches the device.  Enable with
+:func:`enable_tracing` (optionally with a JSONL structured-event sink
+for post-hoc analysis — one JSON object per line, written as events
+complete) or the ``TDX_TRACE_DIR`` environment variable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "enable_tracing",
+    "disable_tracing",
+    "request_trace_events",
+]
+
+
+class Tracer:
+    """Append-only span/instant/counter recorder.
+
+    Events are stored with absolute ``time.monotonic`` second timestamps
+    and converted to the chrome-trace microsecond timebase (relative to
+    the tracer's origin) only at :meth:`export` — so events built from
+    OTHER monotonic timestamps (the serve engine's per-request lifecycle)
+    land on the same timeline without clock translation.
+    """
+
+    def __init__(self, enabled: bool = False, max_events: int = 200_000):
+        self.enabled = enabled
+        self._max_events = int(max_events)
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._origin = time.monotonic()
+        self._jsonl = None
+        self._jsonl_path: Optional[str] = None
+
+    # -- recording -------------------------------------------------------
+
+    @property
+    def origin(self) -> float:
+        return self._origin
+
+    def _add(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                # never let an unbounded serve run eat the host: drop,
+                # but COUNT the drop so export can say the trace is
+                # truncated instead of silently looking complete
+                self._dropped += 1
+                return
+            self._events.append(ev)
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(ev) + "\n")
+                # flush per event: the sink exists for post-hoc analysis
+                # of runs that may die mid-flight (wedged relay, killed
+                # bench phase) and for live tail -f; host spans are
+                # ms-scale, so a per-line flush is noise
+                self._jsonl.flush()
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args: Any) -> Iterator[None]:
+        """Record a complete ("X") event around the body.  No-op (and
+        allocation-free on the hot path) when the tracer is disabled."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            t1 = time.monotonic()
+            self._add(
+                {
+                    "ph": "X",
+                    "name": name,
+                    "cat": cat,
+                    "ts": t0,
+                    "dur": t1 - t0,
+                    "tid": threading.get_ident() & 0x7FFFFFFF,
+                    **({"args": args} if args else {}),
+                }
+            )
+
+    def instant(self, name: str, cat: str = "host", **args: Any) -> None:
+        if not self.enabled:
+            return
+        self._add(
+            {
+                "ph": "i",
+                "name": name,
+                "cat": cat,
+                "ts": time.monotonic(),
+                "s": "t",
+                "tid": threading.get_ident() & 0x7FFFFFFF,
+                **({"args": args} if args else {}),
+            }
+        )
+
+    def counter(self, name: str, **values: float) -> None:
+        """Chrome-trace counter track (stacked series per key)."""
+        if not self.enabled:
+            return
+        self._add(
+            {
+                "ph": "C",
+                "name": name,
+                "cat": "counter",
+                "ts": time.monotonic(),
+                "tid": 0,
+                "args": dict(values),
+            }
+        )
+
+    # -- sinks / export --------------------------------------------------
+
+    def open_jsonl(self, path: str) -> str:
+        """Stream every subsequent event as one JSON line to ``path``
+        (the post-hoc analysis sink — absolute monotonic timestamps, so
+        lines from several components interleave consistently)."""
+        self.close_jsonl()
+        self._jsonl = open(path, "w")
+        self._jsonl_path = path
+        return path
+
+    def close_jsonl(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+
+    def export(
+        self, path: str, extra_events: Optional[List[dict]] = None
+    ) -> str:
+        """Write a catapult/Perfetto ``{"traceEvents": [...]}`` JSON.
+
+        ``extra_events`` are pre-built chrome-format events whose ``ts``
+        (and ``dur``) are still in absolute monotonic SECONDS — e.g.
+        :func:`request_trace_events` — converted here with the same
+        origin as the tracer's own spans."""
+        us = 1e6
+        out = []
+        for ev in self.events() + list(extra_events or []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = round((ev["ts"] - self._origin) * us, 3)
+            if "dur" in ev:
+                ev["dur"] = round(ev["dur"] * us, 3)
+            ev.setdefault("pid", 1)
+            ev.setdefault("tid", 0)
+            out.append(ev)
+        doc: Dict[str, Any] = {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+        }
+        if self._dropped:
+            doc["metadata"] = {"dropped_events": self._dropped}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+_TRACER = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The module-level tracer every instrumented component records into.
+    Disabled by default; ``TDX_TRACE_DIR`` (checked once, at first use
+    after import) or :func:`enable_tracing` turns it on."""
+    return _TRACER
+
+
+def enable_tracing(jsonl_path: Optional[str] = None) -> Tracer:
+    _TRACER.enabled = True
+    if jsonl_path:
+        _TRACER.open_jsonl(jsonl_path)
+    return _TRACER
+
+
+def disable_tracing() -> Tracer:
+    _TRACER.enabled = False
+    _TRACER.close_jsonl()
+    return _TRACER
+
+
+# honor the env knob at import: scripts that fork phase subprocesses
+# (bench_serve) can turn tracing on for every child without plumbing
+if os.environ.get("TDX_TRACE_DIR"):
+    _dir = os.environ["TDX_TRACE_DIR"]
+    try:
+        os.makedirs(_dir, exist_ok=True)
+        enable_tracing(
+            os.path.join(_dir, f"events_{os.getpid()}.jsonl")
+        )
+    except OSError:
+        _TRACER.enabled = True  # tracing on, sink unavailable
+
+
+_REQUEST_PID = 2  # chrome-trace process id grouping the request tracks
+
+
+def request_trace_events(requests, name_prefix: str = "req") -> List[dict]:
+    """Per-request lifecycle spans, one chrome-trace thread row per
+    request: ``queued`` (submit -> admitted), ``prefill`` (admitted ->
+    first token), ``decode`` (first token -> finish), plus an instant
+    per recorded lifecycle event.  Built from the very same ``Request``
+    timestamps that feed the ``ServeMetrics`` histograms, so the spans
+    and the aggregates provably agree (pinned in tests/test_obs.py).
+
+    Timestamps stay in absolute monotonic seconds — pass the result to
+    :meth:`Tracer.export` as ``extra_events``.
+    """
+    out: List[dict] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": _REQUEST_PID,
+            "tid": 0,
+            "args": {"name": "serve requests"},
+        }
+    ]
+    for req in requests:
+        tid = int(req.rid) + 1  # tid 0 is the metadata row
+        out.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": _REQUEST_PID,
+                "tid": tid,
+                "args": {"name": f"{name_prefix} {req.rid}"},
+            }
+        )
+        phases = []
+        if req.admitted_at is not None:
+            phases.append(("queued", req.submitted_at, req.admitted_at))
+            if req.first_token_at is not None:
+                phases.append(
+                    ("prefill", req.admitted_at, req.first_token_at)
+                )
+                if req.finished_at is not None:
+                    phases.append(
+                        ("decode", req.first_token_at, req.finished_at)
+                    )
+        elif req.finished_at is not None:  # expired while queued
+            phases.append(("queued", req.submitted_at, req.finished_at))
+        for name, t0, t1 in phases:
+            out.append(
+                {
+                    "ph": "X",
+                    "name": name,
+                    "cat": "request",
+                    "pid": _REQUEST_PID,
+                    "tid": tid,
+                    "ts": t0,
+                    "dur": max(0.0, t1 - t0),
+                    "args": {"rid": int(req.rid)},
+                }
+            )
+        for name, ts, data in getattr(req, "events", ()):
+            out.append(
+                {
+                    "ph": "i",
+                    "name": name,
+                    "cat": "lifecycle",
+                    "pid": _REQUEST_PID,
+                    "tid": tid,
+                    "ts": ts,
+                    "s": "t",
+                    **({"args": data} if data else {}),
+                }
+            )
+    return out

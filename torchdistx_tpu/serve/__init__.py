@@ -9,6 +9,12 @@ max-tokens budget, free-page gating, and per-request deadlines
 (:mod:`~torchdistx_tpu.serve.scheduler`), a two-compiled-program engine
 (:mod:`~torchdistx_tpu.serve.engine`), and plain-dict metrics
 (:mod:`~torchdistx_tpu.serve.metrics`).
+
+Observability (docs/observability.md): every request carries a
+lifecycle event log, the engine exports per-request Perfetto traces
+(``ServeEngine.dump_trace``), and ``ServeMetrics.collector()`` exposes
+the metric set in Prometheus text format through
+:mod:`torchdistx_tpu.obs`.
 """
 
 from .engine import ServeEngine

@@ -60,12 +60,15 @@ contiguous (``page_size=None``) engine (tests/test_serve.py).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..obs.trace import get_tracer, request_trace_events
 
 from ..generation import (
     _cached_jit,
@@ -162,6 +165,14 @@ class ServeEngine:
         ``False`` keeps paged allocation without sharing.
       params: parameter dict override (e.g. sharded params); default
         ``dict(model.named_parameters())``.
+      finished_history: how many finished requests to retain for
+        per-request trace export (``dump_trace`` /
+        ``finished_requests``).  Each retained request holds its prompt
+        array, generated tokens, and lifecycle event list (one
+        ``decode_chunk`` event per dispatch), so a long-running
+        production engine with big prompts may want this small — 0
+        disables retention entirely (lifecycle events still accumulate
+        on in-flight requests and ride out on ``RequestResult.events``).
     """
 
     def __init__(
@@ -180,6 +191,7 @@ class ServeEngine:
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
         params: Optional[dict] = None,
+        finished_history: int = 1024,
     ):
         _check_sampling_args(top_k, top_p)
         cfg = getattr(model, "cfg", None)
@@ -265,6 +277,11 @@ class ServeEngine:
         self._seeds = np.zeros(self.num_slots, np.int32)
         self._ntok = np.zeros(self.num_slots, np.int32)  # tokens sampled
         self._budget = np.zeros(self.num_slots, np.int32)  # max_new_tokens
+        # bounded history of finished requests, kept for per-request
+        # trace export (dump_trace) — each carries its full lifecycle
+        # event list and the timestamps the aggregate histograms used.
+        # maxlen=0 (finished_history=0) retains nothing.
+        self._finished: deque = deque(maxlen=int(finished_history))
 
     # -- public API ------------------------------------------------------
 
@@ -373,6 +390,27 @@ class ServeEngine:
         while self.step():
             pass
         return [h.result() for h in handles]
+
+    def finished_requests(self) -> List[Request]:
+        """The bounded finished-request history (newest last): each entry
+        carries the full lifecycle event log and the exact timestamps the
+        aggregate histograms were fed from."""
+        return list(self._finished)
+
+    def dump_trace(self, path: str) -> str:
+        """Export the host trace as a catapult/Perfetto ``traceEvents``
+        JSON: the global tracer's spans (engine dispatches, scheduler,
+        page pool, anything else instrumented in-process) plus one
+        thread row per finished request (queued/prefill/decode spans +
+        lifecycle instants).  Complements — never replaces — a
+        ``jax.profiler`` trace of the same run (docs/observability.md).
+        Request rows are exported even when tracing was disabled
+        (lifecycle events are always recorded); enable tracing to get
+        the dispatch spans alongside them."""
+        tracer = get_tracer()
+        return tracer.export(
+            path, extra_events=request_trace_events(self._finished)
+        )
 
     def num_compiled_programs(self) -> Optional[int]:
         """Compiled executables behind THIS engine's serving programs —
@@ -589,17 +627,26 @@ class ServeEngine:
         self._budget[slot] = req.max_new_tokens
         now = time.monotonic()
         req.first_token_at = now
+        req.record_event("first_token", ts=now)
         req.generated.append(tok)
         self.metrics.count("host_syncs")
         self.metrics.count("prefill_calls")
         self.metrics.count("requests_admitted")
         self.metrics.count("tokens_generated")
-        self.metrics.ttft_s.record(now - req.submitted_at)
-        self.metrics.queue_wait_s.record((req.admitted_at or now) - req.submitted_at)
+        # aggregate histograms are fed from the request's OWN lifecycle
+        # timestamps (not a second clock read), so the per-request view
+        # (RequestResult.ttft_s / queue_wait_s, the Perfetto request
+        # track) and these aggregates provably agree — pinned in
+        # tests/test_obs.py
+        self.metrics.ttft_s.record(req.first_token_at - req.submitted_at)
+        self.metrics.queue_wait_s.record(
+            (req.admitted_at or now) - req.submitted_at
+        )
         self._check_finished(req, tok, now)
 
     def _dispatch_prefill_slab(self, req: Request, slot: int) -> int:
         bucket = self._bucket_for(req.prompt.size)
+        req.record_event("prefill", bucket=bucket, cold=True)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : req.prompt.size] = req.prompt
         program = self._prefill_program(bucket)
@@ -629,6 +676,9 @@ class ServeEngine:
         ps, pfx = self.page_size, req.prefix_len
         suffix = req.prompt[pfx:]
         bucket = self._bucket_for(suffix.size)
+        req.record_event(
+            "prefill", bucket=bucket, cold=pfx == 0, prefix_hit_tokens=pfx
+        )
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : suffix.size] = suffix
         self.cache.set_table(slot, req.pages)
@@ -703,6 +753,7 @@ class ServeEngine:
         emitted = 0
         for req in running:
             slot = req.slot
+            took = 0
             for j in range(k_steps):
                 tok = int(block[j, slot])
                 self._ntok[slot] += 1
@@ -710,11 +761,19 @@ class ServeEngine:
                 self._last_tok[slot] = tok
                 req.generated.append(tok)
                 emitted += 1
+                took = j + 1
                 if self._check_finished(req, tok, now):
                     # the device froze this slot for the rest of the
                     # chunk; those slot-steps bought nothing
                     self.metrics.count("masked_slot_steps", k_steps - 1 - j)
                     break
+            ev = ("decode_chunk", now, {"tokens": took})
+            if req.events and req.events[-1][0] == "finish":
+                # _check_finished logged the finish inside the loop; keep
+                # the lifecycle log in causal order (chunk, then finish)
+                req.events.insert(-1, ev)
+            else:
+                req.events.append(ev)
         self.metrics.count("tokens_generated", emitted)
         self.metrics.count("tokens_decoded", emitted)
         if emitted:
@@ -746,10 +805,17 @@ class ServeEngine:
         self._temps[slot] = 0.0
         req.finish_reason = reason
         req.finished_at = now
+        req.record_event("finish", ts=now, reason=reason)
         self._count_finish(req)
 
     def _count_finish(self, req: Request) -> None:
         self.metrics.count("requests_completed")
-        if req.result().truncated:
+        result = req.result()
+        if result.truncated:
             self.metrics.count("requests_truncated")
-        self.metrics.e2e_latency_s.record(req.finished_at - req.submitted_at)
+        # derived per-request latencies feed the aggregates (same
+        # timestamps as RequestResult / the per-request trace track)
+        self.metrics.e2e_latency_s.record(result.latency_s)
+        if result.tpot_s is not None:
+            self.metrics.tpot_s.record(result.tpot_s)
+        self._finished.append(req)

@@ -24,9 +24,16 @@ __all__ = ["Histogram", "ServeMetrics"]
 class Histogram:
     """Bounded-reservoir histogram of float observations.
 
-    Keeps the most recent ``maxlen`` samples (serving runs are unbounded;
-    all-time exact quantiles are not worth unbounded memory) while count
-    and sum stay exact over the full lifetime.
+    **Window semantics** (read this before putting a quantile on a
+    dashboard): ``count`` and ``total`` (hence ``mean``) are exact over
+    the histogram's full LIFETIME, but the reservoir keeps only the most
+    recent samples — after an overflow compaction it holds between
+    ``maxlen // 2`` and ``maxlen`` of them — so ``p50``/``p95``/``max``
+    describe a recent window, not all time.  ``window_count`` in
+    :meth:`snapshot` says how many samples the quantiles actually saw:
+    ``window_count < count`` means the reservoir has wrapped and a p95
+    labeled "all-time" would be a misread.  (Serving runs are unbounded;
+    all-time exact quantiles are not worth unbounded memory.)
     """
 
     def __init__(self, maxlen: int = 4096):
@@ -44,6 +51,11 @@ class Histogram:
             # drop the oldest half in one slice instead of popping per call
             self._samples = self._samples[self._maxlen // 2 :]
 
+    @property
+    def window_count(self) -> int:
+        """Samples currently in the quantile window (<= ``count``)."""
+        return len(self._samples)
+
     def _quantile(self, q: float) -> Optional[float]:
         if not self._samples:
             return None
@@ -55,6 +67,9 @@ class Histogram:
         return {
             "count": self.count,
             "mean": self.total / self.count if self.count else None,
+            # window stats (see class docstring): quantiles and max look
+            # at the last window_count samples only
+            "window_count": self.window_count,
             "p50": self._quantile(0.50),
             "p95": self._quantile(0.95),
             "max": max(self._samples) if self._samples else None,
@@ -85,18 +100,28 @@ class ServeMetrics:
     ``pages_in_use`` / ``pages_in_use_hwm`` (current and high-water
     allocated pages) and ``num_pages``.
     Histograms: ``ttft_s`` (submit -> first token on host),
-    ``e2e_latency_s``, ``queue_wait_s``, ``slot_occupancy`` (active /
-    total slots, sampled per decode dispatch), ``prefill_s`` /
-    ``decode_s`` (per-dispatch wall times, fetch included), and
-    ``decode_token_s`` (decode dispatch wall time / tokens it emitted —
-    the per-token latency a consumer actually experiences, amortized
-    over the chunk).
+    ``e2e_latency_s``, ``queue_wait_s``, ``tpot_s`` (per finished
+    request: decode seconds per token after the first — the
+    time-per-output-token figure, derived from the request's OWN
+    lifecycle timestamps so the aggregate and ``RequestResult.tpot_s``
+    provably agree), ``slot_occupancy`` (active / total slots, sampled
+    per decode dispatch), ``prefill_s`` / ``decode_s`` (per-dispatch
+    wall times, fetch included), and ``decode_token_s`` (decode dispatch
+    wall time / tokens it emitted — the per-token latency a consumer
+    actually experiences, amortized over the chunk).
+
+    Prometheus: :meth:`collector` re-registers this whole set through an
+    ``obs.metrics.MetricsRegistry`` (counters -> ``*_total``, gauges
+    verbatim, histograms -> summaries with window quantiles — see the
+    :class:`Histogram` window note); ``snapshot()``/``to_json()`` stay
+    the source of truth and the exposition is a live projection of them.
     """
 
     _HISTOGRAMS = (
         "ttft_s",
         "e2e_latency_s",
         "queue_wait_s",
+        "tpot_s",
         "slot_occupancy",
         "prefill_s",
         "decode_s",
@@ -131,6 +156,7 @@ class ServeMetrics:
         self.ttft_s = Histogram()
         self.e2e_latency_s = Histogram()
         self.queue_wait_s = Histogram()
+        self.tpot_s = Histogram()
         self.slot_occupancy = Histogram()
         self.prefill_s = Histogram()
         self.decode_s = Histogram()
@@ -220,3 +246,53 @@ class ServeMetrics:
                 out[f"{name}_{k}"] = v
         out.update(j["derived"])
         return out
+
+    def collector(self, prefix: str = "tdx_serve"):
+        """An ``obs.metrics`` collector over THIS object's live state —
+        register with ``registry.register_collector(m.collector(),
+        obj=m)`` so a rebound ``engine.metrics`` drops out of the
+        exposition when the old object is collected.  Rendering reads
+        :meth:`to_json`, so the exposition can never drift from the
+        JSON/snapshot schema."""
+        import weakref
+
+        from ..obs.metrics import MetricFamily
+
+        # close over a weakref, not self: a registered collector must
+        # not pin a rebound engine.metrics object in the exposition
+        ref = weakref.ref(self)
+
+        def collect():
+            self = ref()
+            if self is None:
+                return []
+            j = self.to_json()
+            fams = []
+            for name, v in j["counters"].items():
+                fams.append(
+                    MetricFamily(
+                        f"{prefix}_{name}_total", "counter"
+                    ).add(v)
+                )
+            for name, v in j["gauges"].items():
+                fams.append(
+                    MetricFamily(f"{prefix}_{name}", "gauge").add(v)
+                )
+            for name, s in j["histograms"].items():
+                base = name[:-2] + "_seconds" if name.endswith("_s") else name
+                fam = MetricFamily(f"{prefix}_{base}", "summary")
+                fam.add(s["p50"], quantile="0.5")
+                fam.add(s["p95"], quantile="0.95")
+                hist = getattr(self, name)
+                fam.add(hist.total, "_sum")
+                fam.add(hist.count, "_count")
+                fams.append(fam)
+                # quantile-window size (Histogram window semantics)
+                fams.append(
+                    MetricFamily(
+                        f"{prefix}_{base}_window_count", "gauge"
+                    ).add(s["window_count"])
+                )
+            return fams
+
+        return collect

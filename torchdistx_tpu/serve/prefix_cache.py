@@ -36,6 +36,8 @@ from typing import Dict, Iterable, List, Tuple
 
 import numpy as np
 
+from ..obs.trace import get_tracer
+
 __all__ = ["PagePool", "RadixPrefixIndex"]
 
 SCRATCH_PAGE = 0
@@ -91,6 +93,11 @@ class PagePool:
         for p in pages:
             self._ref[p] = 1
         self.high_water = max(self.high_water, self.in_use)
+        # counter track on the host trace: pool pressure over time (the
+        # counter() call is a no-op unless tracing is enabled)
+        get_tracer().counter(
+            "page_pool", in_use=self.in_use, free=self.free_count
+        )
         return pages
 
     def incref(self, pages: Iterable[int]) -> None:
@@ -110,6 +117,10 @@ class PagePool:
             if self._ref[p] == 0:
                 heapq.heappush(self._free, p)
                 freed += 1
+        if freed:
+            get_tracer().counter(
+                "page_pool", in_use=self.in_use, free=self.free_count
+            )
         return freed
 
 
@@ -221,14 +232,17 @@ class RadixPrefixIndex:
         Returns pages actually freed — possibly fewer when everything
         left is pinned by running requests."""
         freed = 0
-        while freed < n_needed:
-            # re-collect after EVERY eviction: removing a leaf exposes
-            # its parent, which is older than any other leaf of its
-            # chain and must compete on its own recency
-            leaves = self._evictable_leaves(pool)
-            if not leaves:
-                break
-            _, parent, key = min(leaves, key=lambda t: t[0])
-            node = parent.pop(key)
-            freed += pool.decref([node.page])
+        with get_tracer().span(
+            "prefix_index/evict", cat="page_pool", needed=n_needed
+        ):
+            while freed < n_needed:
+                # re-collect after EVERY eviction: removing a leaf exposes
+                # its parent, which is older than any other leaf of its
+                # chain and must compete on its own recency
+                leaves = self._evictable_leaves(pool)
+                if not leaves:
+                    break
+                _, parent, key = min(leaves, key=lambda t: t[0])
+                node = parent.pop(key)
+                freed += pool.decref([node.page])
         return freed

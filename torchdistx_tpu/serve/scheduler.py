@@ -39,7 +39,12 @@ class RequestResult:
     """Terminal state of one request.  ``tokens`` are the GENERATED ids
     only (prompt excluded); ``truncated`` means the request ended before
     its own stopping rule (deadline or cache exhaustion) and ``tokens``
-    is a partial result."""
+    is a partial result.  ``queue_wait_s``/``tpot_s`` are the other two
+    derived latencies (submit -> admitted, and decode seconds per token
+    after the first); ``events`` is the request's full lifecycle event
+    list (``(name, monotonic_ts, data)``) — the same timestamps that fed
+    the engine's aggregate histograms, so a per-request view can always
+    be reconciled against ``ServeMetrics`` (docs/observability.md)."""
 
     rid: int
     tokens: np.ndarray
@@ -47,6 +52,9 @@ class RequestResult:
     truncated: bool
     ttft_s: Optional[float]
     latency_s: float
+    queue_wait_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    events: List[tuple] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -68,6 +76,17 @@ class Request:
     # -- paged-KV reservation (engine's admission gate stashes these) ----
     pages: Optional[List[int]] = None  # page chain, prefix order
     prefix_len: int = 0  # page-aligned tokens served from the prefix cache
+    # -- lifecycle event log (observability) -----------------------------
+    # (name, monotonic_ts, data-dict-or-None) appended by the scheduler
+    # and engine at every state change: submit -> admitted/gated/expire ->
+    # prefill -> first_token -> decode_chunk* -> finish.  JSON-able;
+    # exported as per-request Perfetto tracks by obs.trace.
+    events: List[tuple] = dataclasses.field(default_factory=list)
+
+    def record_event(self, name: str, ts: Optional[float] = None, **data):
+        self.events.append(
+            (name, time.monotonic() if ts is None else ts, data or None)
+        )
 
     @property
     def cost(self) -> int:
@@ -87,6 +106,15 @@ class Request:
     def result(self) -> RequestResult:
         if self.finish_reason is None:
             raise RuntimeError(f"request {self.rid} is not finished")
+        tpot = None
+        if (
+            self.first_token_at is not None
+            and self.finished_at is not None
+            and len(self.generated) > 1
+        ):
+            tpot = (self.finished_at - self.first_token_at) / (
+                len(self.generated) - 1
+            )
         return RequestResult(
             rid=self.rid,
             tokens=np.asarray(self.generated, np.int32),
@@ -99,6 +127,13 @@ class Request:
             ),
             latency_s=(self.finished_at or time.monotonic())
             - self.submitted_at,
+            queue_wait_s=(
+                None
+                if self.admitted_at is None
+                else self.admitted_at - self.submitted_at
+            ),
+            tpot_s=tpot,
+            events=list(self.events),
         )
 
 
@@ -141,6 +176,7 @@ class Scheduler:
     def submit(self, request: Request) -> None:
         request.rid = next(self._rid)
         request.submitted_at = time.monotonic()
+        request.record_event("submit", ts=request.submitted_at)
         self._queue.append(request)
 
     @property
@@ -170,6 +206,7 @@ class Scheduler:
             self._queue.remove(r)
             r.finish_reason = "deadline"
             r.finished_at = now
+            r.record_event("expire", ts=now, where="queued")
         return expired
 
     def admit(self, now: float, gate=None) -> List[Tuple[Request, int]]:
@@ -190,17 +227,29 @@ class Scheduler:
                 > self.max_tokens_in_flight
                 and self._running
             ):
+                self._record_gated(head, now, "token_budget")
                 break  # budget holds until running requests retire
             if gate is not None and not gate(head):
+                self._record_gated(head, now, "gate")
                 break  # e.g. pages free up only when running requests end
             self._queue.popleft()
             slot = self._free_slots.pop()
             head.slot = slot
             head.admitted_at = now
+            head.record_event("admitted", ts=now, slot=slot)
             self._running[slot] = head
             self._in_flight_tokens += head.cost
             admitted.append((head, slot))
         return admitted
+
+    @staticmethod
+    def _record_gated(head: Request, now: float, why: str) -> None:
+        """One lifecycle event per CHANGE of gating cause, not per tick —
+        a long-blocked head would otherwise accumulate an event per
+        ``step()`` and swamp its trace row."""
+        if not (head.events and head.events[-1][0] == "gated"
+                and (head.events[-1][2] or {}).get("why") == why):
+            head.record_event("gated", ts=now, why=why)
 
     def retire(self, request: Request) -> None:
         """Return a running request's slot to the free pool (the caller

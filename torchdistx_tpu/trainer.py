@@ -16,6 +16,7 @@ from typing import Any, Callable, Iterable, Optional
 
 import jax
 
+from .obs.trace import get_tracer
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
 
 __all__ = ["Trainer"]
@@ -70,6 +71,17 @@ class Trainer:
         self.global_step = 0
         self._history: list[float] = []
         self._last_checkpoint: Optional[str] = None
+        # live telemetry the Prometheus collector projects
+        # (metrics_collector); loss/steps_per_sec update at log
+        # boundaries — where they are realized anyway, zero extra syncs
+        self.metrics: dict = {
+            "steps_total": 0,
+            "tokens_total": 0,
+            "checkpoints_total": 0,
+            "failures_total": 0,
+            "loss": None,
+            "steps_per_sec": None,
+        }
 
     # -- checkpoint --------------------------------------------------------
 
@@ -77,15 +89,19 @@ class Trainer:
         path = path or os.path.join(
             self.checkpoint_dir or ".", f"step_{self.global_step}"
         )
-        save_checkpoint(
-            path,
-            {
-                "params": self.params,
-                "opt_state": self.opt_state,
-                "global_step": self.global_step,
-            },
-        )
+        with get_tracer().span(
+            "trainer/checkpoint", cat="trainer", step=self.global_step
+        ):
+            save_checkpoint(
+                path,
+                {
+                    "params": self.params,
+                    "opt_state": self.opt_state,
+                    "global_step": self.global_step,
+                },
+            )
         self._last_checkpoint = path
+        self.metrics["checkpoints_total"] += 1
         return path
 
     def restore(self, path: str) -> None:
@@ -130,11 +146,21 @@ class Trainer:
                 batch = next(it)
             except StopIteration:
                 break
-            self.params, self.opt_state, loss = self.step(
-                self.params, self.opt_state, batch
-            )
+            # a host tracer span per step (obs.trace — no-op unless
+            # tracing is enabled); the dispatch is async, so the span
+            # measures host-side submit time, not device step time —
+            # device time shows at the log boundaries' block_until_ready
+            with get_tracer().span(
+                "trainer/step", cat="trainer", step=self.global_step
+            ):
+                self.params, self.opt_state, loss = self.step(
+                    self.params, self.opt_state, batch
+                )
             self.global_step += 1
             window_steps += 1
+            self.metrics["steps_total"] += 1
+            if self.tokens_per_batch:
+                self.metrics["tokens_total"] += self.tokens_per_batch
 
             if warmup_pending:
                 # exclude the first step's jit compile from throughput
@@ -161,6 +187,13 @@ class Trainer:
                             self.global_step, dt, window_steps
                         )
                     except StepFailure as failure:
+                        self.metrics["failures_total"] += 1
+                        get_tracer().instant(
+                            "trainer/failure",
+                            cat="trainer",
+                            kind=failure.kind,
+                            step=self.global_step,
+                        )
                         failed_step = self.global_step  # before any rollback
                         action = apply_failure_policy(
                             self, failure, self.on_failure
@@ -181,6 +214,8 @@ class Trainer:
                     "loss": round(last_loss, 6),
                     "steps_per_sec": round(window_steps / dt, 3),
                 }
+                self.metrics["loss"] = last_loss
+                self.metrics["steps_per_sec"] = window_steps / dt
                 if self.tokens_per_batch:
                     metrics["tokens_per_sec"] = round(
                         self.tokens_per_batch * window_steps / dt, 1
@@ -216,3 +251,48 @@ class Trainer:
             "step": self.global_step,
             "loss": float(loss) if loss is not None else float("nan"),
         }
+
+    # -- observability -----------------------------------------------------
+
+    def metrics_collector(self, prefix: str = "tdx_train"):
+        """An ``obs.metrics`` collector over this trainer's live metrics
+        (``registry.register_collector(t.metrics_collector(), obj=t)``):
+        ``*_total`` counters for steps/tokens/checkpoints/failures, plus
+        ``loss`` / ``steps_per_sec`` / ``global_step`` gauges from the
+        latest log boundary."""
+        import weakref
+
+        from .obs.metrics import MetricFamily
+
+        ref = weakref.ref(self)  # don't pin the trainer in a registry
+
+        def collect():
+            self = ref()
+            if self is None:
+                return []
+            m = self.metrics
+            fams = []
+            for name in (
+                "steps_total",
+                "tokens_total",
+                "checkpoints_total",
+                "failures_total",
+            ):
+                fams.append(
+                    MetricFamily(f"{prefix}_{name}", "counter").add(m[name])
+                )
+            fams.append(
+                MetricFamily(f"{prefix}_global_step", "gauge").add(
+                    self.global_step
+                )
+            )
+            for name in ("loss", "steps_per_sec"):
+                if m[name] is not None:
+                    fams.append(
+                        MetricFamily(f"{prefix}_{name}", "gauge").add(
+                            m[name]
+                        )
+                    )
+            return fams
+
+        return collect

@@ -19,7 +19,14 @@ from typing import Any
 V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
 
 
-def warm_to_steady_state(run, carry, sync, max_calls: int = 5):
+def warm_to_steady_state(
+    run,
+    carry,
+    sync,
+    max_calls: int = 5,
+    watcher=None,
+    label: str = "warm_to_steady_state",
+):
     """Call ``run(carry) -> (carry, aux)`` until no call compiles anything
     new, returning ``(carry, warm_times, converged)``.  ``converged`` is
     False when ``max_calls`` ran out with the compile cache still growing
@@ -32,24 +39,44 @@ def warm_to_steady_state(run, carry, sync, max_calls: int = 5):
     carry comes back with executable-chosen layouts that differ from the
     host-staged originals — a new input-layout signature.  (Round-2's
     "5.5% MFU" was a timed window that caught that hidden 30 s+ recompile;
-    steady state measures ~9x faster.)  Steadiness is detected by the jit
-    cache size reaching a fixpoint, with a timing heuristic as fallback
-    where the private ``_cache_size`` API is unavailable; ``sync(aux)``
-    must block until the call's work is done (e.g. fetch a loss to host).
+    steady state measures ~9x faster.)  ``sync(aux)`` must block until the
+    call's work is done (e.g. fetch a loss to host).
+
+    Steadiness signals, best first: an ``obs.RecompileWatcher`` passed as
+    ``watcher`` counts actual backend compiles per call (each call runs
+    under ``recompile_scope(label)``, so the donated-carry recompile lands
+    in ``watcher.counts[label]`` as an ASSERTABLE number — exactly 1 extra
+    compile on donation-capable backends, 0 on the CPU mesh where donation
+    is a no-op); then the jit cache size reaching a fixpoint
+    (``utils.compat.jit_cache_size``); then a timing heuristic where the
+    private API is unavailable and no watcher was given.
     """
+    import contextlib
     import time
 
-    cache_size = getattr(run, "_cache_size", lambda: None)
+    from .compat import jit_cache_size
+
     warm_times = []
     prev_cache = -1
     converged = False
     for _ in range(max_calls):
+        before = watcher.total if watcher is not None else None
+        scope = (
+            watcher.scope(label)
+            if watcher is not None
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        carry, aux = run(carry)
-        sync(aux)
+        with scope:
+            carry, aux = run(carry)
+            sync(aux)
         warm_times.append(time.perf_counter() - t0)
-        cur_cache = cache_size()
-        if cur_cache is not None:
+        cur_cache = jit_cache_size(run)
+        if watcher is not None and watcher.available:
+            if watcher.total == before:
+                converged = True  # this call compiled nothing -> steady
+                break
+        elif cur_cache is not None:
             if cur_cache == prev_cache:
                 converged = True  # no compile happened this call -> steady
                 break

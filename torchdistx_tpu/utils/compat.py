@@ -8,6 +8,11 @@ driver) writes the NEW spelling and imports the wrapper from here (or via
 the ``parallel.compat`` re-export), so the whole codebase tracks one jax
 version boundary in one place.
 
+The observability layer adds two more drift-prone surfaces tracked
+here: the private jit ``_cache_size`` introspection
+(:func:`jit_cache_size`) and the ``jax.monitoring`` compile-event hook
+(:func:`register_compile_listener`) behind ``obs.recompile``.
+
 Lives under ``utils`` so leaf consumers (``ops.attention``, the model
 forwards) can use ``axis_size`` without importing the parallel package —
 ``parallel/__init__`` eagerly pulls in fsdp/pp/tp/optax, which is both
@@ -27,7 +32,12 @@ except ImportError:  # pre-rename jax: experimental namespace, check_rep
 
     _LEGACY_KW = True
 
-__all__ = ["shard_map", "axis_size"]
+__all__ = [
+    "shard_map",
+    "axis_size",
+    "jit_cache_size",
+    "register_compile_listener",
+]
 
 
 def shard_map(f, **kwargs):
@@ -46,3 +56,40 @@ def axis_size(axis) -> int:
     from jax import core
 
     return core.axis_frame(axis)
+
+
+def jit_cache_size(fn):
+    """Compiled-executable count behind a jitted callable, or None.
+
+    ``_cache_size`` is a private jax API that has already moved once;
+    every consumer (``ServeEngine.num_compiled_programs``,
+    ``utils.benchmarks.warm_to_steady_state``, the recompile watcher's
+    fallback path) reads it through here so the next rename is a
+    one-line fix.  None means "unknown", never "zero" — callers must
+    fall back to another steadiness signal, not assume no compiles."""
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        return None
+    try:
+        return int(cache_size())
+    except Exception:
+        return None
+
+
+def register_compile_listener(cb) -> bool:
+    """Register ``cb(event_key, duration_s)`` for ``jax.monitoring``
+    duration events (the ``/jax/core/compile/backend_compile_duration``
+    stream the recompile watcher counts).  Returns False when this jax
+    has no monitoring surface (the watcher then reports
+    ``available: False`` rather than silently counting nothing).
+    Registration is permanent — jax.monitoring has no unregister — so
+    callers register ONE dispatcher and fan out themselves."""
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    reg = getattr(monitoring, "register_event_duration_secs_listener", None)
+    if reg is None:
+        return False
+    reg(cb)
+    return True

@@ -3,14 +3,26 @@
 The reference has no tracing/metrics at all (SURVEY §5.1, §5.5); on TPU the
 canonical tools are XLA profiler traces (viewable in TensorBoard/XProf) and
 PJRT device memory counters.  These helpers wrap them with zero deps.
+
+:func:`timed_annotation` is the unification point with the host-side
+telemetry layer (:mod:`~torchdistx_tpu.obs`): one region lands on the
+XLA timeline (``jax.profiler`` annotation), on the host Perfetto trace
+(``obs.trace`` span), in a metrics histogram (the ``sink``), and as a
+recompile-attribution scope (``obs.recompile``) — so the serve engine's
+``serve/prefill`` / ``serve/decode`` dispatch regions mean the same
+thing in every view.
 """
 
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, Iterator, Optional
 
 import jax
+
+from ..obs.recompile import recompile_scope
+from ..obs.trace import get_tracer
 
 __all__ = [
     "trace",
@@ -45,12 +57,17 @@ def timed_annotation(name: str, sink: Optional[Any] = None) -> Iterator[dict]:
     if given (e.g. a ``serve.metrics.Histogram.record``).  The serving
     engine wraps its prefill/decode dispatches with this so a profiler
     trace and the metrics snapshot describe the same regions.
-    """
-    import time
 
+    The region is also a host tracer span (``obs.trace``, no-op unless
+    tracing is enabled) and a recompile-attribution scope
+    (``obs.recompile``): an XLA compile fired inside it is counted under
+    ``name`` by any installed ``RecompileWatcher``.
+    """
     out: dict = {}
     t0 = time.perf_counter()
-    with annotate(name):
+    with annotate(name), recompile_scope(name), get_tracer().span(
+        name, cat="dispatch"
+    ):
         yield out
     out["seconds"] = time.perf_counter() - t0
     if sink is not None:
