@@ -61,10 +61,17 @@ def _train_throughput():
     )
 
     from torchdistx_tpu.obs import RecompileWatcher, recompile_scope
+    from torchdistx_tpu.obs.flight import get_flight_recorder
 
+    flight = get_flight_recorder()
+    t_phase0 = _time.perf_counter()
     n_steps = 20
     w = build_train_workload(n_steps)
     run, carry = w["run"], w["carry"]
+    flight.record(
+        "bench_train_start", model=w["name"], steps=n_steps,
+        batch=w["batch"], seq=w["seq"],
+    )
 
     # warm to the layout fixpoint — a single warm call would time the
     # donated-carry recompile, round-2's measurement bug (see
@@ -90,7 +97,24 @@ def _train_throughput():
     toks = n_steps * w["batch"] * w["seq"]
     tokens_per_sec = toks / dt
     mfu = tokens_per_sec * w["flops_per_token"] / _PEAK
+    # goodput: the timed window's productive fraction of the phase —
+    # everything else is warmup/compile (the donated-carry tax made
+    # visible as a ratio, not just a warm-call list)
+    phase_s = _time.perf_counter() - t_phase0
+    goodput = dt / phase_s if phase_s > 0 else None
+    flight.record(
+        "bench_train_end",
+        tokens_per_sec=round(tokens_per_sec, 1),
+        mfu=round(mfu, 4),
+        goodput=round(goodput, 4) if goodput else None,
+        warm_converged=warm_converged,
+        compiles=watcher.snapshot()["compiles_total"],
+    )
     return {
+        # crash-dump telemetry: the black box for THIS phase subprocess
+        # (always written; the parent embeds the path in the record)
+        "flight_dump": flight.dump(reason="bench_train"),
+        "goodput": round(goodput, 4) if goodput else None,
         "train_model": w["name"],
         "train_params": w["n_params"],
         "train_batch": w["batch"],
@@ -136,6 +160,11 @@ def _materialize_7b(replay_mode: str) -> dict:
     tdx.materialize_module(model)
     jax.block_until_ready([p for _, p in model.named_parameters()])
     t_mat = time.time() - t0
+    # the machine-checkable memory plan (obs.memory): sharding-audit
+    # summary + device/host watermark for the 7B materialization
+    from torchdistx_tpu.obs import memory_report
+
+    mem = memory_report(model)
     return {
         "replay_mode": replay_mode,
         "deferred_init_s": round(t_defer, 3),
@@ -145,6 +174,7 @@ def _materialize_7b(replay_mode: str) -> dict:
         "peak_host_rss_gb": round(
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 3
         ),
+        "memory": mem,
         "device": str(jax.devices()[0]),
     }
 
@@ -246,6 +276,10 @@ def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
             "vs_baseline": round(60.0 / total, 3) if eager_ok else None,
             "tokens_per_sec": train.pop("tokens_per_sec", None),
             "mfu": train.pop("mfu", None),
+            # training-telemetry fields (ISSUE 5): productive fraction of
+            # the train phase + the phase's flight-recorder dump path
+            "goodput": train.pop("goodput", None),
+            "flight_dump": train.pop("flight_dump", None),
             "extra": {
                 "progress": progress,
                 "preflight": preflight,
@@ -262,6 +296,7 @@ def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
                 "materialize_s": eager.get("materialize_s"),
                 "params": eager.get("params"),
                 "peak_host_rss_gb": eager.get("peak_host_rss_gb"),
+                "memory": eager.get("memory"),
                 "north_star": "<60s, <32GB host RAM (BASELINE.json cfg 5)",
                 "device": eager.get("device"),
                 "materialize_eager_status": ("ok" if eager_ok else eager),

@@ -1,7 +1,8 @@
-"""Validate the observability artifacts of a bench_serve run (CI smoke).
+"""Validate the observability artifacts of a bench run (CI smoke).
 
-Given the bench record (``BENCH_SERVE_CPU.json`` or a file holding the
-last stdout line), for every phase that embedded observability paths:
+Given a bench record (``BENCH_SERVE_CPU.json``, a ``bench.py`` record,
+or a file holding the last stdout line), for every phase that embedded
+observability paths:
 
 - the Perfetto trace must ``json.load`` and satisfy the catapult
   ``traceEvents`` schema (list of events with ``name``/``ph``; complete
@@ -11,12 +12,21 @@ last stdout line), for every phase that embedded observability paths:
   parser (``obs.parse_prometheus``) with every serve counter EQUAL to
   the same counter in the phase's embedded ``metrics`` JSON — the
   exposition is a projection of ``to_json()``, and this is the gate
-  that keeps the two schemas from drifting apart.
+  that keeps the two schemas from drifting apart;
+- any embedded ``flight_dump`` path must be a schema-valid flight JSONL
+  (``obs.flight.validate_flight_jsonl``) and any embedded ``comm``
+  profile must satisfy the ``tdx-comm-v1`` schema
+  (``obs.comm.validate_comm_profile``).
 
 Exit nonzero (with a reason per failure) when anything is off; print a
 one-line OK summary otherwise.  Stdlib + torchdistx_tpu.obs only.
 
-Usage:  python scripts/check_obs_artifacts.py BENCH_SERVE_CPU.json
+Usage:
+  python scripts/check_obs_artifacts.py BENCH_SERVE_CPU.json
+  python scripts/check_obs_artifacts.py --flight /path/flight.jsonl
+    (standalone flight-record validation — the nightly crash-injection
+    smoke's gate; with --expect-rollback the record must also contain a
+    rollback entry naming the restored step and checkpoint)
 """
 
 from __future__ import annotations
@@ -28,6 +38,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from torchdistx_tpu.obs import parse_prometheus  # noqa: E402
+from torchdistx_tpu.obs.comm import validate_comm_profile  # noqa: E402
+from torchdistx_tpu.obs.flight import validate_flight_jsonl  # noqa: E402
 
 
 def check_trace(path: str, errors: list) -> int:
@@ -90,7 +102,60 @@ def check_prom(path: str, metrics_json: dict, errors: list) -> int:
     return len(samples)
 
 
+def check_flight(path: str, errors: list, expect_rollback: bool = False) -> int:
+    errs = validate_flight_jsonl(path)
+    errors.extend(errs)
+    if errs:
+        return 0
+    with open(path) as f:
+        records = [json.loads(ln) for ln in f.read().splitlines() if ln.strip()]
+    for rec in records:
+        if isinstance(rec.get("comm"), dict) and "schema" in rec["comm"]:
+            errors.extend(
+                f"{path}: {e}" for e in validate_comm_profile(rec["comm"])
+            )
+    if expect_rollback:
+        rollbacks = [r for r in records if r.get("kind") == "rollback"]
+        if not rollbacks:
+            errors.append(f"{path}: no rollback entry in flight record")
+        for r in rollbacks:
+            if not isinstance(r.get("restored_step"), int) or not r.get(
+                "checkpoint"
+            ):
+                errors.append(
+                    f"{path}: rollback entry lacks restored_step/checkpoint: "
+                    f"{r!r:.200}"
+                )
+    return len(records)
+
+
+def _check_flight_main(argv: list) -> None:
+    expect_rollback = "--expect-rollback" in argv
+    unknown = [
+        a for a in argv if a.startswith("--") and a != "--expect-rollback"
+    ]
+    if unknown:
+        # a typoed flag must NOT silently weaken the gate (e.g.
+        # --expect_rollback passing a rollback-free dump as OK)
+        raise SystemExit(f"unknown flag(s) {unknown}\n\n{__doc__}")
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    for p in paths:
+        n = check_flight(p, errors, expect_rollback=expect_rollback)
+        print(f"flight {p}: {n} records")
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"flight records OK ({len(paths)} file(s))")
+
+
 def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--flight":
+        _check_flight_main(sys.argv[2:])
+        return
     if len(sys.argv) != 2:
         raise SystemExit(__doc__)
     with open(sys.argv[1]) as f:
@@ -114,6 +179,13 @@ def main() -> None:
             f"phase {name}: {n_events} trace events, "
             f"{n_samples} exposition samples"
         )
+    # bench.py records: a top-level flight_dump (train phase's black box)
+    # must be schema-valid when present and readable on this host
+    dump = record.get("flight_dump")
+    if dump and os.path.exists(dump):
+        checked += 1
+        n = check_flight(dump, errors)
+        print(f"flight {dump}: {n} records")
     if checked == 0:
         errors.append(
             "no phase carried observability artifacts — was "
@@ -123,7 +195,7 @@ def main() -> None:
         for e in errors:
             print(f"FAIL: {e}", file=sys.stderr)
         raise SystemExit(1)
-    print(f"observability artifacts OK ({checked} phase(s))")
+    print(f"observability artifacts OK ({checked} check(s))")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,133 @@
+"""Nightly crash-injection smoke: an injected-NaN ``fit()`` MUST leave a
+flight-record dump behind (ISSUE 5 crash-path contract).
+
+Builds a tiny FSDP trainer on the 8-virtual-CPU mesh, trains a few clean
+steps (so a health-gated checkpoint exists), poisons a parameter with
+NaN, and lets the failure policy roll back.  The gate then demands:
+
+- ``Trainer.last_flight_dump`` exists inside ``TDX_FLIGHT_DIR``;
+- the dump is schema-valid (``check_obs_artifacts.py --flight`` logic)
+  AND its tail shows the rollback (restored step + checkpoint path);
+- the streaming sink (``flight_<pid>.jsonl``, the per-event-flush
+  kill -9 channel) also exists and validates — the evidence a hard kill
+  would have left.
+
+Exit nonzero with a reason when any artifact is missing — a crash that
+leaves no black box is THE regression this smoke exists to catch.
+
+Usage:  TDX_FLIGHT_DIR=/tmp/flight python scripts/crash_injection_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if not os.environ.get("TDX_FLIGHT_DIR"):
+    os.environ["TDX_FLIGHT_DIR"] = tempfile.mkdtemp(prefix="tdx_flight_")
+FLIGHT_DIR = os.environ["TDX_FLIGHT_DIR"]
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import torchdistx_tpu as tdx  # noqa: E402
+from torchdistx_tpu import nn  # noqa: E402
+from torchdistx_tpu.nn import functional_call  # noqa: E402
+from torchdistx_tpu.parallel import ShardedTrainStep, create_mesh  # noqa: E402
+from torchdistx_tpu.trainer import Trainer  # noqa: E402
+from torchdistx_tpu.utils.failure import FailureDetector  # noqa: E402
+
+from check_obs_artifacts import check_flight  # noqa: E402
+
+
+class _MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 16)
+
+    def forward(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+def main() -> None:
+    mesh = create_mesh({"fsdp": 8})
+    tdx.manual_seed(0)
+    model = tdx.deferred_init(_MLP)
+    tdx.materialize_module(model)
+    params = dict(model.named_parameters())
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((functional_call(model, p, (x,)) - y) ** 2)
+
+    step = ShardedTrainStep(loss_fn, optax.sgd(1e-2), mesh, shard_axis="fsdp")
+    params = step.shard_params(params)
+    opt_state = step.init_optimizer(params)
+
+    rs = np.random.RandomState(0)
+    batches = [(b, b) for b in (rs.randn(8, 16).astype(np.float32)
+                                for _ in range(8))]
+    trainer = Trainer(
+        step, params, opt_state,
+        checkpoint_dir=tempfile.mkdtemp(prefix="crash_smoke_ck_"),
+        checkpoint_every=2, log_every=1, log_fn=lambda m: None,
+        failure_detector=FailureDetector(nan_tolerance=0),
+        on_failure="restore",
+    )
+    trainer.fit(batches[:4])
+
+    poisoned = dict(trainer.params)
+    k0 = next(iter(poisoned))
+    poisoned[k0] = poisoned[k0] * jnp.float32(np.nan)
+    trainer.params = poisoned
+    res = trainer.fit(batches[4:])
+
+    errors: list = []
+    dump = trainer.last_flight_dump
+    if not dump:
+        errors.append("injected-NaN fit() produced NO flight dump")
+    elif not dump.startswith(FLIGHT_DIR):
+        errors.append(
+            f"dump {dump} landed outside TDX_FLIGHT_DIR={FLIGHT_DIR}"
+        )
+    else:
+        n = check_flight(dump, errors, expect_rollback=True)
+        print(f"crash dump {dump}: {n} records")
+
+    stream = os.path.join(FLIGHT_DIR, f"flight_{os.getpid()}.jsonl")
+    if not os.path.exists(stream):
+        errors.append(f"per-event streaming sink missing: {stream}")
+    else:
+        check_flight(stream, errors)
+
+    if not np.isfinite(res["loss"]):
+        errors.append(f"rollback did not recover the run: {res}")
+
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(
+        "crash-injection smoke OK: "
+        + json.dumps({"dump": dump, "stream": stream, "final": res})
+    )
+
+
+if __name__ == "__main__":
+    main()

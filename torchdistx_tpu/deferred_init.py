@@ -182,6 +182,18 @@ def materialize_module(
 
     for i, (store, name, _, _) in enumerate(entries):
         store[name] = results[i]
+
+    # memory-audit stamp (obs.memory): totals + device/host watermark for
+    # the flight recorder and bench evidence — metadata only, no sync
+    try:
+        from .obs import memory as _obs_memory
+        from .obs.comm import tree_bytes
+
+        _obs_memory.record_materialize(
+            len(entries), tree_bytes(list(results.values()))
+        )
+    except Exception:
+        pass  # the audit must never fail a materialization
     return module
 
 

@@ -16,8 +16,24 @@ engine, trainer, and deferred-init replay (docs/observability.md):
   recompile watcher counting and attributing XLA compiles per scope
   (the donated-carry double compile from CLAUDE.md becomes a named
   counter instead of a timing artifact).
+
+PR 5 adds the *training-side* layer on the same substrate
+(docs/observability.md "Training telemetry"):
+
+- :mod:`~torchdistx_tpu.obs.comm` — trace-time collective-traffic audit
+  with analytic per-axis byte accounting (arXiv:2112.01075), assertable
+  in tests.
+- :mod:`~torchdistx_tpu.obs.memory` — post-materialization sharding &
+  HBM audit (accidental replication, unsharded optimizer state, device
+  watermark).
+- :mod:`~torchdistx_tpu.obs.flight` — bounded flight-recorder ring with
+  per-event-flush streaming and atomic crash dumps (the NCCL flight
+  recorder analog).
 """
 
+from .comm import CommProfile, comm_audit, record_collective
+from .flight import FlightRecorder, get_flight_recorder
+from .memory import hbm_watermark, memory_report, sharding_report
 from .metrics import (
     Counter,
     Gauge,
@@ -29,7 +45,7 @@ from .metrics import (
     render_prometheus,
     start_metrics_server,
 )
-from .recompile import RecompileWatcher, recompile_scope
+from .recompile import RecompileWatcher, recompile_scope, track_jit_cache
 from .trace import (
     Tracer,
     disable_tracing,
@@ -55,4 +71,13 @@ __all__ = [
     "start_metrics_server",
     "RecompileWatcher",
     "recompile_scope",
+    "track_jit_cache",
+    "CommProfile",
+    "comm_audit",
+    "record_collective",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "sharding_report",
+    "hbm_watermark",
+    "memory_report",
 ]

@@ -353,10 +353,22 @@ _DEFAULT: Optional[MetricsRegistry] = None
 def default_registry() -> MetricsRegistry:
     """Process-wide registry (created on first use): what the ``/metrics``
     endpoint and the recompile watcher register into unless told
-    otherwise."""
+    otherwise.  Runtime collectors install themselves on creation:
+    ``tdx_jit_cache_size{fn=...}`` for jits registered via
+    ``obs.recompile.track_jit_cache`` and the flight recorder's
+    depth/capacity/dump gauges (``obs.flight``)."""
     global _DEFAULT
     if _DEFAULT is None:
         _DEFAULT = MetricsRegistry()
+        try:
+            from .flight import get_flight_recorder
+            from .recompile import jit_cache_collector
+
+            _DEFAULT.register_collector(jit_cache_collector())
+            rec = get_flight_recorder()
+            _DEFAULT.register_collector(rec.collector(), obj=rec)
+        except Exception:
+            pass  # registry must exist even if a runtime collector can't
     return _DEFAULT
 
 

@@ -36,7 +36,13 @@ from typing import Dict, Iterator, List, Optional
 
 from ..utils.compat import register_compile_listener
 
-__all__ = ["RecompileWatcher", "recompile_scope", "current_scope"]
+__all__ = [
+    "RecompileWatcher",
+    "recompile_scope",
+    "current_scope",
+    "track_jit_cache",
+    "jit_cache_collector",
+]
 
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
@@ -86,6 +92,63 @@ def _ensure_listener() -> bool:
     if _listener_state is None:
         _listener_state = register_compile_listener(_on_event)
     return _listener_state
+
+
+_tracked_jits: Dict[str, object] = {}
+
+
+def track_jit_cache(name: str, fn: object) -> None:
+    """Register a jitted callable so its compiled-executable count shows
+    up as ``tdx_jit_cache_size{fn="<name>"}`` on the default registry's
+    ``/metrics`` — compile-cache growth during a long serve/train becomes
+    scrapeable instead of a post-mortem ``_cache_size`` probe.
+
+    Held by weakref when the callable supports it (jit wrappers do), so
+    tracking never pins a step program; a later registration under the
+    same name replaces the earlier one (rebuilt steps).
+    """
+    import weakref
+
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:
+        ref = lambda _fn=fn: _fn  # non-weakrefable: hold it
+    with _lock:
+        _tracked_jits[str(name)] = ref
+
+
+def jit_cache_collector(prefix: str = "tdx_jit"):
+    """An ``obs.metrics`` collector over every tracked jit cache
+    (auto-registered on the default registry — obs.metrics)."""
+    from .metrics import MetricFamily
+
+    def collect():
+        from ..utils.compat import jit_cache_size
+
+        with _lock:
+            tracked = dict(_tracked_jits)
+        fam = MetricFamily(
+            f"{prefix}_cache_size",
+            "gauge",
+            "compiled executables behind tracked jitted callables",
+        )
+        dead = []
+        for name, ref in tracked.items():
+            fn = ref()
+            if fn is None:
+                dead.append(name)
+                continue
+            size = jit_cache_size(fn)
+            if size is not None:
+                fam.add(size, fn=name)
+        if dead:
+            with _lock:
+                for name in dead:
+                    if _tracked_jits.get(name) is tracked[name]:
+                        del _tracked_jits[name]
+        return [fam] if fam.samples else []
+
+    return collect
 
 
 class RecompileWatcher:

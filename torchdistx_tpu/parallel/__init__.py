@@ -1,6 +1,11 @@
 from . import collectives
 from .comm_hooks import DefaultState, HookContext, allreduce_hook, noop_hook
-from .fsdp import ShardedTrainStep, fsdp_partition_spec, fsdp_shard_rule
+from .fsdp import (
+    ShardedTrainStep,
+    fsdp_partition_spec,
+    fsdp_shard_rule,
+    optimizer_state_shardings,
+)
 from .gossip_grad import (
     GossipGraDState,
     Topology,
@@ -26,6 +31,7 @@ __all__ = [
     "ShardedTrainStep",
     "fsdp_partition_spec",
     "fsdp_shard_rule",
+    "optimizer_state_shardings",
     "GossipGraDState",
     "Topology",
     "gossip_grad_hook",

@@ -15,6 +15,13 @@ Mapping (SURVEY §5.8 / §2.4):
 These functions are *collective-inside-computation*: they must run inside a
 ``shard_map`` (or pmap) region over the named axis.  Pytree-valued inputs
 are supported everywhere, since gradient pytrees are the common operand.
+
+Every function is an audit choke point: when an ``obs.comm.comm_audit``
+profile is active on the tracing thread, the call records its op count
+and analytic payload/wire bytes per axis (a no-op otherwise — one
+thread-local read).  The custom-VJP pairs also record their *backward*
+collectives, which are Python traced under vjp; plain psum transposes
+are jaxpr-level and out of audit scope (see obs/comm.py).
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.comm import record_collective as _record
 from .compat import axis_size as _axis_size
 
 __all__ = [
@@ -44,12 +52,14 @@ __all__ = [
 
 def all_reduce(tree: Any, axis: str) -> Any:
     """Sum over the mesh axis (c10d allreduce / NCCL AllReduce analog)."""
+    _record("all_reduce", axis, tree)
     return jax.tree_util.tree_map(lambda x: lax.psum(x, axis), tree)
 
 
 def all_mean(tree: Any, axis: str) -> Any:
     """Mean over the mesh axis (the reference's default allreduce hook
     divides by world size, FSDP default.allreduce_hook)."""
+    _record("all_mean", axis, tree)
     return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)
 
 
@@ -60,6 +70,7 @@ def broadcast(tree: Any, axis: str, source: int = 0) -> Any:
     lowering is mask-and-psum, which XLA recognizes and turns into an
     efficient collective.
     """
+    _record("broadcast", axis, tree)
     idx = lax.axis_index(axis)
 
     def bc(x):
@@ -93,6 +104,10 @@ def exchange(
     if fill not in ("self", "zero"):
         raise ValueError(f"fill must be 'self' or 'zero', got {fill!r}")
     perm = [(i, int(d)) for i, d in enumerate(send_to) if int(d) >= 0]
+    _record(
+        "exchange", axis, tree,
+        axis_size=len(send_to), senders=len(perm),
+    )
     if recv_from is not None:
         implied = {dst: src for src, dst in perm}
         for i, src in enumerate(recv_from):
@@ -121,17 +136,29 @@ def exchange(
 def shift(tree: Any, axis: str, offset: int = 1) -> Any:
     """Ring shift by ``offset`` (the ring-collective building block)."""
     n = _axis_size(axis)
+    _record("shift", axis, tree, axis_size=n, senders=n)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis, perm), tree)
 
 
 def all_gather(tree: Any, axis: str, tiled_axis: int = 0) -> Any:
+    from ..obs.comm import current_comm_profile, tree_bytes
+
+    if current_comm_profile() is not None:
+        # payload is the GATHERED size (audit convention, obs/comm.py);
+        # the operand here is the local shard
+        n = _axis_size(axis)
+        _record(
+            "all_gather", axis,
+            payload_bytes=tree_bytes(tree) * n, axis_size=n,
+        )
     return jax.tree_util.tree_map(
         lambda x: lax.all_gather(x, axis, axis=tiled_axis, tiled=True), tree
     )
 
 
 def reduce_scatter(tree: Any, axis: str, scatter_axis: int = 0) -> Any:
+    _record("reduce_scatter", axis, tree)
     return jax.tree_util.tree_map(
         lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
         tree,
@@ -152,12 +179,17 @@ def allreduce_linear(tree: Any, axis: str) -> Any:
 
     @jax.custom_vjp
     def g(x):
+        _record("allreduce_linear", axis, x)
         return lax.psum(x, axis)
 
     def g_fwd(x):
+        _record("allreduce_linear", axis, x)
         return lax.psum(x, axis), None
 
     def g_bwd(_, ct):
+        # identity backward: zero wire traffic, recorded so audits show
+        # the op was traversed (kind's wire ratio is 0)
+        _record("allreduce_linear_bwd", axis, ct)
         return (ct,)
 
     g.defvjp(g_fwd, g_bwd)
@@ -179,6 +211,7 @@ def copy_psum_grad(tree: Any, axis: str) -> Any:
         return x, None
 
     def f_bwd(_, ct):
+        _record("copy_psum_grad_bwd", axis, ct)
         return (lax.psum(ct, axis),)
 
     f.defvjp(f_fwd, f_bwd)

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..obs.comm import record_collective as _record_comm, tree_bytes as _leaf_bytes
 from .compat import shard_map
 
 from .comm_hooks import DefaultState, Hook, HookContext, allreduce_hook
@@ -351,6 +352,12 @@ class ShardedTrainStep:
                 return x
             for d, ax in enumerate(spec):
                 if ax == shard_axis:
+                    # audit payload = the GATHERED (full-parameter) bytes
+                    _record_comm(
+                        "all_gather", shard_axis,
+                        payload_bytes=_leaf_bytes(x) * n_shard,
+                        axis_size=n_shard,
+                    )
                     return lax.all_gather(x, shard_axis, axis=d, tiled=True)
             return x
 
@@ -359,12 +366,24 @@ class ShardedTrainStep:
                 return g
             for d, ax in enumerate(spec):
                 if ax == shard_axis:
+                    # the classic FSDP gradient reduce-scatter: payload is
+                    # the full gradient (== parameter) bytes — the number
+                    # tests/test_comm_audit.py pins against param_bytes
+                    _record_comm(
+                        "reduce_scatter", shard_axis,
+                        payload_bytes=_leaf_bytes(g),
+                        axis_size=n_shard,
+                    )
                     return (
                         lax.psum_scatter(
                             g, shard_axis, scatter_dimension=d, tiled=True
                         )
                         / n_shard
                     )
+            _record_comm(
+                "pmean", shard_axis,
+                payload_bytes=_leaf_bytes(g), axis_size=n_shard,
+            )
             return lax.pmean(g, shard_axis)
 
         def tree_with_specs(fn, tree):
@@ -407,12 +426,20 @@ class ShardedTrainStep:
             else:
                 loss, grads = local_grad(full, batch)
             if grad_reduce_axes:
+                for _ax in grad_reduce_axes:
+                    _record_comm(
+                        "pmean", _ax, grads, axis_size=mesh.shape[_ax]
+                    )
                 grads = jax.tree_util.tree_map(
                     lambda g: lax.pmean(g, grad_reduce_axes), grads
                 )
             g_shards = tree_with_specs(scatter_grad_leaf, grads)
             ctx = HookContext(replica_axes=ctx_axes, step=hook_step)
             g_shards = hook(state, g_shards, ctx)
+            for _ax in all_axes:
+                _record_comm(
+                    "pmean", _ax, loss, axis_size=mesh.shape[_ax]
+                )
             loss = lax.pmean(loss, all_axes)
             return loss, g_shards
 
@@ -437,6 +464,9 @@ class ShardedTrainStep:
             return params, opt_state, loss
 
         self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        from ..obs.recompile import track_jit_cache
+
+        track_jit_cache("sharded_train_step", self._jitted)
         del spec_tree
 
     def __call__(self, params: Any, opt_state: Any, batch: Any):

@@ -57,8 +57,10 @@ from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from ..obs.comm import record_collective as _record_comm, tree_bytes as _tree_bytes
 from .compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -136,6 +138,18 @@ def pipeline_apply(
     ticks = n_micro + n_stages - 1
 
     def body(p_local, mb):
+        # scan bodies trace once: the audit must record the schedule's
+        # STATIC trip count (ticks ppermutes of one activation each),
+        # not the single traced occurrence (obs/comm.py docstring)
+        _act_bytes = _tree_bytes(mb) // mb.shape[0]
+        _record_comm(
+            "exchange", axis, payload_bytes=_act_bytes, count=ticks,
+            axis_size=n_stages, senders=n_stages - 1,
+        )
+        _record_comm(
+            "all_reduce", axis,
+            payload_bytes=_act_bytes * n_micro, axis_size=n_stages,
+        )
         p = jax.tree_util.tree_map(lambda a: a[0], p_local)
         idx = lax.axis_index(axis)
         is_first = idx == 0
@@ -236,6 +250,21 @@ def pipeline_train_step(
     stash_depth = 2 * n_stages - 1
 
     def body(p_local, mb, tgt):
+        # static 1F1B schedule accounting (scan traces once — see
+        # pipeline_apply): every tick runs one forward and one backward
+        # ppermute of a microbatch activation, 2*ticks total, plus the
+        # final loss psum and the dp reductions below.  Closed form
+        # pinned in tests/test_comm_audit.py.
+        _act_bytes = _tree_bytes(mb) // mb.shape[0]
+        _record_comm(
+            "exchange", axis, payload_bytes=_act_bytes, count=2 * ticks,
+            axis_size=n_stages, senders=n_stages - 1,
+        )
+        _record_comm(
+            "all_reduce", axis,
+            payload_bytes=np.dtype(np.float32).itemsize,
+            axis_size=n_stages,
+        )
         p = jax.tree_util.tree_map(lambda a: a[0], p_local)
         s_idx = lax.axis_index(axis)
         is_first = s_idx == 0
@@ -307,6 +336,12 @@ def pipeline_train_step(
         loss = lax.psum(lacc, axis) / n_micro  # nonzero on last stage only
         gacc = jax.tree_util.tree_map(lambda g: g / n_micro, gacc)
         if dp_axis is not None:
+            _record_comm(
+                "pmean", dp_axis, gacc, axis_size=mesh.shape[dp_axis]
+            )
+            _record_comm(
+                "pmean", dp_axis, loss, axis_size=mesh.shape[dp_axis]
+            )
             loss = lax.pmean(loss, dp_axis)
             gacc = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, dp_axis), gacc

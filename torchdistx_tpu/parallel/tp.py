@@ -127,6 +127,9 @@ class GSPMDTrainStep:
             return params, opt_state, loss
 
         self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        from ..obs.recompile import track_jit_cache
+
+        track_jit_cache("gspmd_train_step", self._jitted)
         self._warned_shardings: set = set()
 
     def init_optimizer(self, params: Any) -> Any:

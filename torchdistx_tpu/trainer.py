@@ -16,6 +16,8 @@ from typing import Any, Callable, Iterable, Optional
 
 import jax
 
+from .obs.comm import CommProfile, comm_audit
+from .obs.flight import get_flight_recorder
 from .obs.trace import get_tracer
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
 
@@ -49,6 +51,9 @@ class Trainer:
         log_fn: Optional[Callable[[dict], None]] = None,
         failure_detector: Optional[Any] = None,
         on_failure: str = "raise",
+        flight: Optional[Any] = None,
+        flops_per_token: Optional[float] = None,
+        peak_flops: Optional[float] = None,
     ) -> None:
         self.step = step
         self.params = params
@@ -71,6 +76,31 @@ class Trainer:
         self.global_step = 0
         self._history: list[float] = []
         self._last_checkpoint: Optional[str] = None
+        # flight recorder (obs.flight): ring-records at log boundaries /
+        # checkpoints / failures, dumped atomically when the run breaks —
+        # defaults to the process-wide recorder (TDX_FLIGHT_DIR sink)
+        self.flight = flight if flight is not None else get_flight_recorder()
+        self.last_flight_dump: Optional[str] = None
+        # collective-traffic audit: the FIRST call of the step program
+        # traces under this profile (obs.comm — trace-time accounting),
+        # so after one step it holds the per-step analytic comm plan
+        self.comm_profile = CommProfile()
+        # MFU: tokens/sec * flops/token / peak; only reported when the
+        # caller supplies the model's flops_per_token (and optionally the
+        # chip peak — default v5e bf16)
+        self.flops_per_token = flops_per_token
+        self.peak_flops = peak_flops
+        # goodput accounting (productive vs compile/checkpoint/rollback
+        # wall time), all host-measured at the same boundaries that
+        # already block on the device
+        self._t_productive = 0.0
+        self._t_compile = 0.0
+        self._t_checkpoint = 0.0
+        self._t_rollback = 0.0
+        # only the FIRST fit()'s first step carries the jit compile; a
+        # later fit on the same (warm) step program must not book its
+        # first window as compile overhead or goodput reads low
+        self._warmed = False
         # live telemetry the Prometheus collector projects
         # (metrics_collector); loss/steps_per_sec update at log
         # boundaries — where they are realized anyway, zero extra syncs
@@ -81,6 +111,9 @@ class Trainer:
             "failures_total": 0,
             "loss": None,
             "steps_per_sec": None,
+            "tokens_per_sec": None,
+            "mfu": None,
+            "goodput": None,
         }
 
     # -- checkpoint --------------------------------------------------------
@@ -89,6 +122,7 @@ class Trainer:
         path = path or os.path.join(
             self.checkpoint_dir or ".", f"step_{self.global_step}"
         )
+        t0 = time.time()
         with get_tracer().span(
             "trainer/checkpoint", cat="trainer", step=self.global_step
         ):
@@ -101,7 +135,12 @@ class Trainer:
                 },
             )
         self._last_checkpoint = path
+        self._t_checkpoint += time.time() - t0
         self.metrics["checkpoints_total"] += 1
+        self.flight.record(
+            "checkpoint", step=self.global_step, path=path,
+            seconds=round(time.time() - t0, 3),
+        )
         return path
 
     def restore(self, path: str) -> None:
@@ -131,10 +170,75 @@ class Trainer:
         num_steps: Optional[int] = None,
     ) -> dict:
         """Run up to ``num_steps`` (or the iterable's length).  Returns final
-        metrics."""
+        metrics.
+
+        Telemetry contract: every log boundary, checkpoint, and failure
+        lands in the flight recorder; an exception (including a
+        ``StepFailure`` escaping under ``on_failure="raise"``) dumps the
+        ring to JSONL before propagating, and a HANDLED NaN/deadline
+        failure dumps too — the rollback evidence must exist even when
+        the run survives (``self.last_flight_dump``).
+        """
+        self.flight.record(
+            "fit_start", step=self.global_step, num_steps=num_steps,
+            rng_counter=self._rng_counter(),
+        )
+        try:
+            return self._fit(batches, num_steps)
+        except BaseException as e:
+            self.flight.record(
+                "exception", step=self.global_step,
+                error=f"{type(e).__name__}: {e}"[:300],
+                last_checkpoint=self._last_checkpoint,
+            )
+            self._safe_dump(f"exception:{type(e).__name__}")
+            raise
+
+    def _safe_dump(self, reason: str) -> Optional[str]:
+        """Write the crash dump without letting telemetry I/O (full or
+        read-only TDX_FLIGHT_DIR) turn a survivable incident — or the
+        original exception — into a telemetry crash."""
+        try:
+            self.last_flight_dump = self.flight.dump(reason=reason)
+        except Exception:
+            pass
+        return self.last_flight_dump
+
+    @staticmethod
+    def _rng_counter() -> int:
+        from .utils.rng import _state
+
+        return int(_state.counter)
+
+    def _update_derived_metrics(self) -> None:
+        """goodput / tokens-per-sec / mfu gauges from the accumulated
+        wall-time split; cheap, host-only."""
+        sps = self.metrics["steps_per_sec"]
+        if sps and self.tokens_per_batch:
+            tps = sps * self.tokens_per_batch
+            self.metrics["tokens_per_sec"] = tps
+            if self.flops_per_token:
+                peak = self.peak_flops
+                if peak is None:
+                    from .utils.benchmarks import V5E_PEAK_BF16 as peak
+                self.metrics["mfu"] = tps * self.flops_per_token / peak
+        overhead = (
+            self._t_compile + self._t_checkpoint + self._t_rollback
+        )
+        if self._t_productive + overhead > 0:
+            self.metrics["goodput"] = self._t_productive / (
+                self._t_productive + overhead
+            )
+
+    def _fit(
+        self,
+        batches: Iterable[Any],
+        num_steps: Optional[int] = None,
+    ) -> dict:
         t_window = time.time()
         window_steps = 0
-        warmup_pending = True  # first step carries jit compile time
+        warmup_pending = not self._warmed  # first-ever step carries compile
+        t_warm0 = time.time()
         loss = None  # device array; only realized at log boundaries / return
         it = iter(batches)
         while True:
@@ -150,9 +254,12 @@ class Trainer:
             # tracing is enabled); the dispatch is async, so the span
             # measures host-side submit time, not device step time —
             # device time shows at the log boundaries' block_until_ready
+            # the comm audit only sees Python-level collectives at TRACE
+            # time, so this is free after the first (compiling) call and
+            # self.comm_profile ends up holding the per-step comm plan
             with get_tracer().span(
                 "trainer/step", cat="trainer", step=self.global_step
-            ):
+            ), comm_audit(self.comm_profile):
                 self.params, self.opt_state, loss = self.step(
                     self.params, self.opt_state, batch
                 )
@@ -166,9 +273,17 @@ class Trainer:
                 # exclude the first step's jit compile from throughput
                 # windows: wait for it, then restart the clock
                 jax.block_until_ready(loss)
+                self._t_compile += time.time() - t_warm0
+                self.flight.record(
+                    "warmup",
+                    step=self.global_step,
+                    seconds=round(time.time() - t_warm0, 3),
+                    comm=self.comm_profile.digest(),
+                )
                 t_window = time.time()
                 window_steps = 0
                 warmup_pending = False
+                self._warmed = True
 
             # window_steps == 0 right after the warmup reset (log_every=1):
             # skip that boundary instead of logging 0.0 steps/sec
@@ -195,9 +310,32 @@ class Trainer:
                             step=self.global_step,
                         )
                         failed_step = self.global_step  # before any rollback
+                        self.flight.record(
+                            "failure",
+                            step=failed_step,
+                            failure_kind=failure.kind,
+                            loss=last_loss,
+                            last_checkpoint=self._last_checkpoint,
+                        )
+                        t_rb = time.time()
+                        # "raise" propagates: _fit's wrapper records the
+                        # exception and dumps the ring before re-raising
                         action = apply_failure_policy(
                             self, failure, self.on_failure
                         )
+                        self._t_rollback += time.time() - t_rb
+                        self.flight.record(
+                            "rollback",
+                            step=failed_step,
+                            action=action,
+                            restored_step=self.global_step,
+                            checkpoint=self._last_checkpoint,
+                            seconds=round(time.time() - t_rb, 3),
+                        )
+                        # the dump IS the incident artifact: write it even
+                        # though the run continues (ISSUE 5 crash-path
+                        # contract — the last entries show the rollback)
+                        self._safe_dump(f"failure:{failure.kind}")
                         self.log_fn(
                             {
                                 "step": failed_step,
@@ -216,11 +354,23 @@ class Trainer:
                 }
                 self.metrics["loss"] = last_loss
                 self.metrics["steps_per_sec"] = window_steps / dt
+                self._t_productive += dt
+                self._update_derived_metrics()
                 if self.tokens_per_batch:
                     metrics["tokens_per_sec"] = round(
                         self.tokens_per_batch * window_steps / dt, 1
                     )
                 self._history.append(last_loss)
+                self.flight.record(
+                    "step",
+                    step=self.global_step,
+                    loss=last_loss,
+                    steps_per_sec=round(window_steps / dt, 3),
+                    window_s=round(dt, 4),
+                    rng_counter=self._rng_counter(),
+                    comm=self.comm_profile.digest(),
+                    last_checkpoint=self._last_checkpoint,
+                )
                 self.log_fn(metrics)
                 t_window = time.time()
                 window_steps = 0
@@ -247,9 +397,18 @@ class Trainer:
                 if healthy:
                     self.save()
 
+        self._update_derived_metrics()
+        self.flight.record(
+            "fit_end",
+            step=self.global_step,
+            loss=float(loss) if loss is not None else None,
+            goodput=self.metrics["goodput"],
+            rng_counter=self._rng_counter(),
+        )
         return {
             "step": self.global_step,
             "loss": float(loss) if loss is not None else float("nan"),
+            "goodput": self.metrics["goodput"],
         }
 
     # -- observability -----------------------------------------------------
@@ -257,9 +416,13 @@ class Trainer:
     def metrics_collector(self, prefix: str = "tdx_train"):
         """An ``obs.metrics`` collector over this trainer's live metrics
         (``registry.register_collector(t.metrics_collector(), obj=t)``):
-        ``*_total`` counters for steps/tokens/checkpoints/failures, plus
-        ``loss`` / ``steps_per_sec`` / ``global_step`` gauges from the
-        latest log boundary."""
+        ``*_total`` counters for steps/tokens/checkpoints/failures,
+        ``loss`` / ``steps_per_sec`` / ``tokens_per_sec`` / ``mfu`` /
+        ``goodput`` / ``global_step`` gauges from the latest log
+        boundary, and — when a :class:`~torchdistx_tpu.utils.failure.
+        FailureDetector` is attached — its live degradation counters
+        (``consecutive_nonfinite``, per-kind ``failure_events_total``)
+        so a run that is *about* to die is scrapeable before it does."""
         import weakref
 
         from .obs.metrics import MetricFamily
@@ -286,13 +449,38 @@ class Trainer:
                     self.global_step
                 )
             )
-            for name in ("loss", "steps_per_sec"):
+            for name in (
+                "loss",
+                "steps_per_sec",
+                "tokens_per_sec",
+                "mfu",
+                "goodput",
+            ):
                 if m[name] is not None:
                     fams.append(
                         MetricFamily(f"{prefix}_{name}", "gauge").add(
                             m[name]
                         )
                     )
+            det = self.failure_detector
+            if det is not None:
+                fams.append(
+                    MetricFamily(
+                        f"{prefix}_consecutive_nonfinite", "gauge"
+                    ).add(det.consecutive_nonfinite)
+                )
+                ev = MetricFamily(
+                    f"{prefix}_failure_events_total",
+                    "counter",
+                    "detector-observed failure events by kind (incl. "
+                    "tolerated ones that have not tripped the policy)",
+                )
+                counts = det.counts_by_kind()
+                for kind in sorted(counts):
+                    ev.add(counts[kind], kind=kind)
+                if not counts:
+                    ev.add(0.0)
+                fams.append(ev)
             return fams
 
         return collect

@@ -92,6 +92,22 @@ class FailureDetector:
         configured tolerance applies afresh to the recovered run."""
         self._consecutive_nonfinite = 0
 
+    # -- observability (projected by Trainer.metrics_collector) ------------
+
+    @property
+    def consecutive_nonfinite(self) -> int:
+        """Current run of non-finite losses — nonzero means the job is
+        degrading even if the tolerance hasn't tripped yet."""
+        return self._consecutive_nonfinite
+
+    def counts_by_kind(self) -> dict:
+        """Lifetime failure-event counts by kind (``nonfinite`` /
+        ``deadline``), including tolerated events that never raised."""
+        out: dict = {}
+        for f in self.failures:
+            out[f["kind"]] = out.get(f["kind"], 0) + 1
+        return out
+
     # -- loss health -------------------------------------------------------
 
     def check_loss(self, step: int, loss: float) -> None:
