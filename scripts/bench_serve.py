@@ -1,12 +1,17 @@
 """Serving throughput: continuous batching through ``serve.ServeEngine``,
-with a fused multi-step decode A/B and an optional shared-prefix A/B.
+with a fused multi-step decode A/B, a persistent-loop A/B, and an
+optional shared-prefix A/B.
 
 Phases: the K=1 baseline FIRST (one host sync per token), then one phase
 per ``--decode-chunk`` value (K decode steps fused into one ``lax.scan``
-dispatch, one sync per K tokens), then — with ``--prefix-share`` — one
-paged-engine phase that runs the SAME repeated-system-prompt burst twice
-through one engine: cold (empty prefix index) and warm (index populated
-by the cold pass).  Warm prefill must compute strictly fewer padded
+dispatch, one sync per K tokens), then — with ``persistent`` in
+``--decode-mode`` (the default) — the persistent whole-loop phase (one
+``lax.while_loop`` dispatch per generation wave, host syncs = ring
+drains only; its summary carries ``syncs_reduction_vs_k16`` against the
+K=16 fused baseline that ran before it), then — with ``--prefix-share``
+— one paged-engine phase that runs the SAME repeated-system-prompt burst
+twice through one engine: cold (empty prefix index) and warm (index
+populated by the cold pass).  Warm prefill must compute strictly fewer padded
 tokens than cold (suffix-only prefill); the phase reports both passes'
 full metrics (``ServeMetrics.to_json()``) plus the warm prefix hit-rate
 and pages-in-use high water, and flags ``error`` when the inequality
@@ -32,7 +37,7 @@ handler can fire (CLAUDE.md); phases run strictly serially (never two TPU
 processes).  The final record is also written to ``BENCH_SERVE_<CPU|TPU>.json``
 at the repo root.
 
-Usage (TPU):  python scripts/bench_serve.py            # K=1 vs 4,8,16
+Usage (TPU):  python scripts/bench_serve.py   # K=1 vs 4,8,16 vs persistent
 Smoke (CPU):  TDX_BENCH_PLATFORM=cpu TDX_SERVE_MODEL=tiny \
                   python scripts/bench_serve.py --decode-chunk 4 \
                   --requests 6 --max-new 8 --slots 2
@@ -62,6 +67,21 @@ def _parse_args():
         default="4,8,16",
         help="comma-separated fused-decode chunk sizes to A/B against the "
         "always-run K=1 baseline",
+    )
+    ap.add_argument(
+        "--decode-mode",
+        default="chunked,persistent",
+        help="comma-separated engine decode modes to bench: 'chunked' "
+        "runs the K=1 baseline + the --decode-chunk sweep, 'persistent' "
+        "appends the whole-loop phase (always after a fused K baseline, "
+        "so the record carries the A/B)",
+    )
+    ap.add_argument(
+        "--ring",
+        type=int,
+        default=None,
+        help="persistent-mode ring capacity (default: the engine's "
+        "max_len — one drain per generation wave)",
     )
     ap.add_argument(
         "--prefix-share",
@@ -99,6 +119,7 @@ def _phase_summary(rec: dict) -> dict:
         "decode_tokens_per_sec": derived.get("decode_tokens_per_sec"),
         "wall_tokens_per_sec": derived.get("wall_tokens_per_sec"),
         "syncs_per_token": derived.get("syncs_per_token"),
+        "host_syncs": counters.get("host_syncs"),
         "decode_token_s_p50": (hists.get("decode_token_s") or {}).get("p50"),
         "decode_token_s_p95": (hists.get("decode_token_s") or {}).get("p95"),
         "masked_slot_steps": counters.get("masked_slot_steps"),
@@ -114,6 +135,13 @@ def _phase_summary(rec: dict) -> dict:
         ),
         "error": rec.get("error"),
     }
+    if rec.get("decode_mode") == "persistent":
+        gauges = m.get("gauges") or {}
+        out.update(
+            ring_drains=counters.get("ring_drains"),
+            loop_iterations=counters.get("loop_iterations"),
+            ring_occupancy_hwm=gauges.get("ring_occupancy_hwm"),
+        )
     if "warm" in rec:  # the prefix-share phase
         out.update(
             prefix_hit_rate_warm=rec.get("prefix_hit_rate_warm"),
@@ -132,16 +160,28 @@ def _supervise(args) -> None:
     deadline = float(os.environ.get("TDX_BENCH_DEADLINE", "1500"))
     t0 = time.monotonic()
     chunks = _chunk_values(args)
+    modes = [m for m in str(args.decode_mode).split(",") if m.strip()]
+    unknown = set(modes) - {"chunked", "persistent"}
+    if unknown:
+        raise SystemExit(f"unknown --decode-mode values: {sorted(unknown)}")
+    if "chunked" not in modes:
+        # the persistent A/B still needs its fused baselines: K=1 (the
+        # sweep's anchor) and the largest requested K (the comparator)
+        chunks = [1] + ([chunks[-1]] if chunks[-1] != 1 else [])
     record: dict = {
         "bench": "serve",
         "model": os.environ.get("TDX_SERVE_MODEL", "llama_1b"),
         "deadline_s": deadline,
         "decode_chunks": chunks,
+        "decode_modes": modes,
         "phases": {},
     }
-    # phase plan: K=1 baseline, the chunk A/B, then (opt-in) the paged
+    # phase plan: K=1 baseline, the chunk A/B, the persistent loop
+    # (always AFTER its fused baselines), then (opt-in) the paged
     # shared-prefix cold/warm A/B at the largest requested chunk
     plan = [(f"k{k}", {"TDX_SERVE_CHUNK": str(k)}) for k in chunks]
+    if "persistent" in modes:
+        plan.append(("persistent", {"TDX_SERVE_PHASE": "persistent"}))
     if args.prefix_share:
         plan.append(
             (
@@ -160,6 +200,22 @@ def _supervise(args) -> None:
             name: _phase_summary(rec)
             for name, rec in record["phases"].items()
         }
+        summ = record["summary"]
+        if "persistent" in summ:
+            # the tentpole headline: persistent syncs/token vs the
+            # largest fused-K baseline that ran before it (k16 on the
+            # default sweep) — >= 4x is the acceptance bar
+            baseline = max(
+                (n for n in summ if n.startswith("k") and n[1:].isdigit()),
+                key=lambda n: int(n[1:]),
+                default=None,
+            )
+            if baseline is not None:
+                spt = summ["persistent"].get("syncs_per_token")
+                base_spt = summ[baseline].get("syncs_per_token")
+                summ["persistent"][f"syncs_reduction_vs_{baseline}"] = (
+                    base_spt / spt if spt and base_spt else None
+                )
         print(json.dumps(record), flush=True)
 
     for name, phase_env in plan:
@@ -271,6 +327,11 @@ def _phase_setup(args, **extra) -> tuple:
 
         obs.enable_tracing()
     k_chunk = int(os.environ.get("TDX_SERVE_CHUNK", "1"))
+    mode = (
+        "persistent"
+        if os.environ.get("TDX_SERVE_PHASE") == "persistent"
+        else "chunked"
+    )
     name = os.environ.get("TDX_SERVE_MODEL", "llama_1b")
     record: dict = {
         "bench": "serve",
@@ -280,6 +341,7 @@ def _phase_setup(args, **extra) -> tuple:
         "max_new_tokens": args.max_new,
         "num_slots": args.slots,
         "decode_chunk": k_chunk,
+        "decode_mode": mode,
         **extra,
     }
     return record, name, k_chunk, plat
@@ -329,8 +391,10 @@ def _build_model(name: str, plat):
 
 
 def _child(args) -> None:
-    """One phase: one engine at one decode_chunk, warm then measure."""
+    """One phase: one engine at one decode_chunk (or the persistent
+    loop), warm then measure."""
     record, name, k_chunk, plat = _phase_setup(args)
+    persistent = record["decode_mode"] == "persistent"
 
     import numpy as np
 
@@ -345,12 +409,17 @@ def _child(args) -> None:
         model = _build_model(name, plat)
         limit = model.cfg.max_seq_len
         max_len = args.max_len or min(limit, 8 * args.max_new)
+        engine_kw: dict = dict(decode_chunk=k_chunk)
+        if persistent:
+            engine_kw = dict(decode_mode="persistent", ring_capacity=args.ring)
         engine = ServeEngine(
             model,
             num_slots=args.slots,
             max_len=max_len,
-            decode_chunk=k_chunk,
+            **engine_kw,
         )
+        if persistent:
+            record["ring_capacity"] = engine.ring_capacity
         rs = np.random.RandomState(0)
         max_prompt = max(1, min(max_len - args.max_new, max_len // 2))
         prompts = [
@@ -361,24 +430,33 @@ def _child(args) -> None:
         # Warm every program the workload can reach PAST the
         # donated-carry layout recompile (CLAUDE.md: never time the
         # second call): two requests per reachable prefill bucket, with
-        # enough tokens that the fused decode program dispatches at
-        # least twice (k_chunk + 2 => two chunks past the prefill
-        # token), then reset metrics so TTFT/prefill/decode histograms
-        # measure steady-state dispatch, not XLA compiles.
-        from torchdistx_tpu.serve.metrics import ServeMetrics
-
+        # enough tokens that the decode program dispatches at least
+        # twice (k_chunk + 2 => two chunks past the prefill token; the
+        # persistent loop dispatches once per run, so the two warm runs
+        # per bucket cover its second-call recompile too), then reset
+        # metrics so TTFT/prefill/decode histograms measure steady-state
+        # dispatch, not XLA compiles.
         warm_new = min(max(3, k_chunk + 2), max_len - max_prompt)
         for b in engine.prefill_buckets:
             plen = max(1, min(b, max_prompt))
-            engine.run([
-                {"prompt": rs.randint(0, 256, (plen,)).astype(np.int32),
-                 "max_new_tokens": warm_new, "temperature": args.temperature,
-                 "seed": 10**6 + j}
-                for j in range(2)
-            ])
+            for j in range(2):
+                # two SERIAL runs of a two-request batch: the repeat
+                # covers the donated-carry second-call recompile even
+                # when one persistent loop drains the whole wave, and
+                # the simultaneous pair covers the persistent path's
+                # chained pending-first-token splice (its second
+                # scatter has a different committed-ness signature
+                # than the first)
+                engine.run([
+                    {"prompt": rs.randint(0, 256, (plen,)).astype(np.int32),
+                     "max_new_tokens": warm_new,
+                     "temperature": args.temperature,
+                     "seed": 10**6 + 2 * j + i}
+                    for i in range(2)
+                ])
             if plen < b:
                 break  # larger buckets unreachable by this workload
-        engine.metrics = ServeMetrics(engine.num_slots)
+        engine.reset_metrics()
         record["recompile_warmup"] = watcher.snapshot()
         watcher.reset()  # the measured window must compile NOTHING
 
@@ -408,7 +486,9 @@ def _child(args) -> None:
             finish_reasons=sorted({r.finish_reason for r in results}),
             kv_cache_gb=round(engine.cache.nbytes / 1e9, 3),
         )
-        _dump_obs(record, engine, f"k{k_chunk}")
+        _dump_obs(
+            record, engine, "persistent" if persistent else f"k{k_chunk}"
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -428,7 +508,6 @@ def _child_prefix(args) -> None:
 
     from torchdistx_tpu import obs
     from torchdistx_tpu.serve import ServeEngine
-    from torchdistx_tpu.serve.metrics import ServeMetrics
 
     watcher = obs.RecompileWatcher()
     try:
@@ -468,7 +547,7 @@ def _child_prefix(args) -> None:
             )
 
         def run_pass():
-            engine.metrics = ServeMetrics(engine.num_slots, engine.num_pages)
+            engine.reset_metrics()
             t0 = time.perf_counter()
             results = engine.run([dict(r) for r in burst])
             wall = time.perf_counter() - t0

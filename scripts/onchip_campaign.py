@@ -5,15 +5,19 @@ processes), every measurement the round needs from a relay-alive window:
 
 1. ``bench.py``             — headline record (train MFU, 7B materialize,
                               kernel-acceptance sweep, fused-CE A/B)
-2. ``bench_flash_attention``— corrected long-context fwd+bwd rows
+2. ``bench_serve``          — first on-chip serve record
+                              (BENCH_SERVE_TPU.json does not exist yet):
+                              fused K sweep + persistent-loop A/B +
+                              shared-prefix cold/warm
+3. ``bench_flash_attention``— corrected long-context fwd+bwd rows
                               (the round-3 32k/64k rows were invalidated
                               by gradient DCE; the harness now consumes
                               every gradient)
-3. ``bench_fused_ce``       — kernel-level fused-vs-unfused loss A/B
-4. ``bench.py --train-phase`` with TDX_BENCH_OPT=8bit      — optimizer A/B
-5. ``bench.py --train-phase`` with REMAT=1 x {full, dots}  — remat A/B
-6. ``bench_generate``       — int8 decode A/B
-7. ``bench_t5_train``       — biased-kernel train delta
+4. ``bench_fused_ce``       — kernel-level fused-vs-unfused loss A/B
+5. ``bench.py --train-phase`` with TDX_BENCH_OPT=8bit      — optimizer A/B
+6. ``bench.py --train-phase`` with REMAT=1 x {full, dots}  — remat A/B
+7. ``bench_generate``       — int8 decode A/B
+8. ``bench_t5_train``       — biased-kernel train delta
 
 Each step is a subprocess under its own slice of a global deadline
 (``TDX_CAMPAIGN_DEADLINE``, default 5400 s); stdout JSON lines are
@@ -49,6 +53,18 @@ def _steps() -> list:
     # emit never races the subprocess kill.
     return [
         ("bench_full", [py, bench], {}, 1600),
+        # serve A/B right after the headline: BENCH_SERVE_TPU.json does
+        # not exist yet (ROADMAP standing constraint) — the first
+        # healthy-relay window must land it.  Default phases: K=1
+        # baseline, the fused K sweep, the persistent whole-loop A/B,
+        # and the shared-prefix cold/warm pass; bench_serve's own
+        # deadline sits UNDER the step budget so its graceful final
+        # record emit never races the subprocess kill.
+        ("serve_engine_ab",
+         [py, os.path.join(sdir, "bench_serve.py"), "--prefix-share"]
+         + (["--decode-chunk", "4", "--requests", "6", "--max-new", "8",
+             "--slots", "2"] if smoke else []),
+         {} if smoke else {"TDX_BENCH_DEADLINE": "800"}, 900),
         ("flash_long_context",
          [py, os.path.join(sdir, "bench_flash_attention.py")]
          + (["--seqs", "256"] if smoke else
@@ -101,6 +117,7 @@ def main() -> None:
                 TDX_BENCH_MODEL="tiny", TDX_BENCH_TRAIN_MODEL="tiny",
                 TDX_BENCH_SEQ="64", TDX_BENCH_DEADLINE="300",
                 TDX_GEN_MODEL="tiny", TDX_T5_MODEL="tiny",
+                TDX_SERVE_MODEL="tiny",
             )
 
     results: dict = {}

@@ -538,6 +538,248 @@ class TestFinishMasking:
         assert engine.metrics.counters["decode_dispatches"] == 2
 
 
+class TestPersistentDecode:
+    """decode_mode="persistent": ONE while_loop dispatch runs to a
+    slot-state fixpoint (or a full ring), the host drains the device
+    ring — and the token streams are BIT-identical to the fused K-step
+    reference across occupancy x greedy/sampled x shared-prefix/paged,
+    because both programs run the same ``_make_decode_body``.  The fast
+    tests cover both occupancies, sampling, paging, ring wraparound,
+    and the budget-bound exit; the slow sweep runs the full grid."""
+
+    def _requests(self, lengths, temperature, n_new=8):
+        return [
+            {"prompt": p, "max_new_tokens": n_new,
+             "temperature": temperature, "seed": i}
+            for i, p in enumerate(_prompts(21, lengths))
+        ]
+
+    def _assert_identical(self, lengths, temperature, *, ring=None,
+                          page_size=None, n_new=8, **kw):
+        model = _llama()
+        reqs = self._requests(lengths, temperature, n_new=n_new)
+        _, base = _run_chunked(model, 4, reqs)
+        engine = ServeEngine(
+            model, num_slots=3, max_len=64, prefill_buckets=(16,),
+            decode_mode="persistent", ring_capacity=ring,
+            page_size=page_size, **kw,
+        )
+        pers = engine.run([dict(r) for r in reqs])
+        for a, b in zip(base, pers):
+            assert a.finish_reason == b.finish_reason
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+        return engine
+
+    def test_greedy_full_and_partial_occupancy_syncs_collapse(self):
+        engine = self._assert_identical((6, 11, 9, 4, 13), 0.0)
+        snap = engine.metrics.snapshot()
+        # THE tentpole invariant: host syncs are exactly the ring
+        # drains — prefill defers its fetch, so syncs/token is ~1/wave,
+        # not ~1/K (5 requests x 8 tokens through 2 drained waves here)
+        assert snap["host_syncs"] == snap["ring_drains"]
+        assert snap["loop_iterations"] == snap["decode_steps"]
+        assert snap["syncs_per_token"] < 0.11  # vs 0.25 at K=4, 1.1 at K=1
+        assert snap["ring_occupancy_hwm"] >= 7  # 7 decode tokens/request
+        assert snap["ring_full_drains"] == 0  # default ring = max_len
+        self._assert_identical((7,), 0.0)
+
+    def test_sampled_full_and_partial_occupancy(self):
+        self._assert_identical((6, 11, 9, 4, 13), 0.9)
+        self._assert_identical((7,), 0.9)
+
+    def test_paged_shared_prefix_streams_identical(self):
+        rs = np.random.RandomState(17)
+        shared = rs.randint(0, 256, (20,)).astype(np.int32)
+        reqs = []
+        for i, n in enumerate((5, 9, 12)):
+            tail = rs.randint(0, 256, (n,)).astype(np.int32)
+            reqs.append(
+                {"prompt": np.concatenate([shared, tail]),
+                 "max_new_tokens": 8, "temperature": 0.0, "seed": i}
+            )
+        model = _llama()
+        _, base = _run_chunked(model, 4, reqs, buckets=(16, 32))
+        paged = ServeEngine(
+            model, num_slots=3, max_len=64, prefill_buckets=(16, 32),
+            decode_mode="persistent", page_size=8,
+        )
+        cold = paged.run([dict(r) for r in reqs])
+        warm = paged.run([dict(r) for r in reqs])  # index now populated
+        for a, b, c in zip(base, cold, warm):
+            assert a.finish_reason == b.finish_reason == c.finish_reason
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+            np.testing.assert_array_equal(a.tokens, c.tokens)
+        assert paged.metrics.counters["prefix_hit_tokens"] > 0
+
+    def test_ring_wraparound_spans_drains(self):
+        """A request outliving one ring continues bit-identically from
+        its frozen carry at the next dispatch: the ring is reused
+        (linear per dispatch), never circularly overwritten in-loop."""
+        engine = self._assert_identical((6, 11, 9), 0.0, ring=3)
+        snap = engine.metrics.snapshot()
+        assert snap["ring_capacity"] == 3
+        assert snap["ring_occupancy_hwm"] == 3  # every ring filled
+        assert snap["ring_drains"] >= 3  # 7 decode tokens over 3-rings
+        assert snap["ring_full_drains"] >= 2
+        assert snap["host_syncs"] == snap["ring_drains"]
+
+    def test_budget_bound_exit_resumes(self):
+        """Unit view of one budget-bound exit: the loop stops at the
+        ring bound with the request unfinished; the host holds exactly
+        first-token + ring tokens and the next step resumes."""
+        model = _llama()
+        engine = ServeEngine(
+            model, num_slots=1, max_len=64, prefill_buckets=(16,),
+            decode_mode="persistent", ring_capacity=4,
+        )
+        h = engine.submit(_prompts(21, (6,))[0], max_new_tokens=12)
+        engine.step()
+        assert not h.done()  # budget-bound exit, not a finish
+        assert len(h._request.generated) == 1 + 4  # prefill + one ring
+        assert engine.metrics.counters["ring_full_drains"] == 1
+        while engine.step():
+            pass
+        assert h.done() and h.result().finish_reason == "length"
+        assert len(h.result().tokens) == 12
+        ref = np.asarray(
+            generate(model, jnp.asarray(_prompts(21, (6,))[0][None]), 12)
+        )[0]
+        np.testing.assert_array_equal(
+            np.concatenate([_prompts(21, (6,))[0], h.result().tokens]), ref
+        )
+
+    def test_eos_first_token_and_one_token_budget(self):
+        """fin0 is computed ON DEVICE (the deferred prefill fetch means
+        the host can't pre-retire): an EOS first token or an
+        already-spent one-token budget must freeze the slot before
+        iteration 0 and still finish with the chunked engine's
+        reason."""
+        model = _llama()
+        prompt = _prompts(3, (5,))[0]
+        first = int(
+            np.asarray(generate(model, jnp.asarray(prompt[None]), 1))[0, -1]
+        )
+        engine = ServeEngine(
+            model, num_slots=1, max_len=64, prefill_buckets=(16,),
+            decode_mode="persistent", eos_token=first,
+        )
+        r = engine.run([{"prompt": prompt, "max_new_tokens": 8}])[0]
+        assert r.finish_reason == "stop" and not r.truncated
+        np.testing.assert_array_equal(r.tokens, [first])
+        engine2 = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,),
+            decode_mode="persistent",
+        )
+        r2 = engine2.run([{"prompt": prompt, "max_new_tokens": 1}])[0]
+        assert r2.finish_reason == "length" and len(r2.tokens) == 1
+
+    def test_frozen_slot_rows_stay_virgin(self):
+        """A slot finishing mid-loop freezes on device: the masked
+        iterations rewrite the frozen row only, so rows past it stay
+        virgin zeros (the chunked finish-mask invariant, loop-sized)."""
+        model = _llama()
+        prompt = _prompts(31, (6,))[0]
+        _, base = _run_chunked(
+            model, 1, [{"prompt": prompt, "max_new_tokens": 20}],
+            num_slots=1, buckets=(8,),
+        )
+        eos = int(base[0].tokens[3])
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(8,),
+            decode_mode="persistent", eos_token=eos,
+        )
+        # batchmate keeps the loop alive past the first slot's finish
+        results = engine.run([
+            {"prompt": prompt, "max_new_tokens": 20},
+            {"prompt": _prompts(32, (6,))[0], "max_new_tokens": 20,
+             "temperature": 0.9, "seed": 5},
+        ])
+        assert results[0].finish_reason == "stop"
+        np.testing.assert_array_equal(results[0].tokens, base[0].tokens[:4])
+        frozen = prompt.size + len(results[0].tokens) - 1
+        k0 = np.asarray(engine.cache.kv[0][0])  # layer 0 K, slot 0 rows
+        assert np.all(k0[0, frozen + 1:] == 0)
+        assert engine.metrics.counters["masked_slot_steps"] > 0
+
+    def test_stream_tail_matches_drain(self):
+        """Opt-in streamed tail: callbacks fire per loop iteration and
+        change nothing about the (authoritative) drained streams."""
+        engine = self._assert_identical(
+            (6, 11), 0.0, persistent_stream=True
+        )
+        assert engine.stream_supported in ("io_callback", "debug_callback")
+        assert engine.metrics.counters["stream_callbacks"] > 0
+
+    def test_stream_falls_back_to_pure_drain(self, monkeypatch):
+        """compat drift shim: with neither io_callback nor
+        jax.debug.callback available, persistent_stream silently
+        degrades to the pure-drain path — same streams, no error."""
+        from torchdistx_tpu.utils import compat
+
+        monkeypatch.setattr(compat, "get_io_callback", lambda: None)
+        monkeypatch.setattr(compat, "get_debug_callback", lambda: None)
+        engine = self._assert_identical(
+            (6, 11), 0.0, persistent_stream=True
+        )
+        assert engine.stream_supported is None
+        assert engine.metrics.counters["stream_callbacks"] == 0
+
+    def test_program_count_stable_after_warmup(self):
+        engine = self._assert_identical((6, 9), 0.0)
+        warm = engine.num_compiled_programs()
+        if warm is None:
+            pytest.skip("jit cache introspection unavailable on this jax")
+        engine.run([dict(r) for r in self._requests((5, 12, 8), 0.0)])
+        assert engine.num_compiled_programs() == warm
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="decode_mode"):
+            ServeEngine(_llama(), max_len=32, decode_mode="turbo")
+        with pytest.raises(ValueError, match="ring_capacity"):
+            ServeEngine(_llama(), max_len=32, ring_capacity=8)
+        with pytest.raises(ValueError, match="ring_capacity"):
+            ServeEngine(
+                _llama(), max_len=32, decode_mode="persistent",
+                ring_capacity=0,
+            )
+        with pytest.raises(ValueError, match="persistent_stream"):
+            ServeEngine(_llama(), max_len=32, persistent_stream=True)
+
+    def test_metrics_geometry_in_json_and_prom(self):
+        """The ISSUE-6 metric satellite: ring counters in to_json() and
+        the Prometheus exposition, ring gauges only when persistent."""
+        from torchdistx_tpu.obs import MetricsRegistry
+        from torchdistx_tpu.serve.metrics import ServeMetrics as SM
+
+        m = SM(num_slots=2, ring_capacity=16)
+        m.count("loop_iterations", 9)
+        m.count("ring_drains", 2)
+        m.observe_ring(7)
+        j = m.to_json()
+        assert j["counters"]["loop_iterations"] == 9
+        assert j["counters"]["ring_drains"] == 2
+        assert j["gauges"]["ring_capacity"] == 16
+        assert j["gauges"]["ring_occupancy_hwm"] == 7
+        reg = MetricsRegistry()
+        reg.register_collector(m.collector(), obj=m)
+        text = reg.render()
+        assert "tdx_serve_ring_drains_total 2" in text
+        assert "tdx_serve_ring_occupancy_hwm 7" in text
+        # chunked engines carry the counters (zero) but not the gauges
+        assert "ring_capacity" not in SM(num_slots=2).to_json()["gauges"]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("ring", [None, 3])
+    @pytest.mark.parametrize("page_size", [None, 8])
+    @pytest.mark.parametrize("lengths", [(6, 11, 9, 4, 13), (7,)])
+    @pytest.mark.parametrize("temperature", [0.0, 0.9])
+    def test_full_grid_bit_identical(self, ring, page_size, lengths,
+                                     temperature):
+        self._assert_identical(
+            lengths, temperature, ring=ring, page_size=page_size
+        )
+
+
 class TestSchedulerUnit:
     def _req(self, n=4, **kw):
         return Request(

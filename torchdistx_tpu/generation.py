@@ -103,6 +103,49 @@ def _make_slot_sampler(
     return sample
 
 
+def _make_decode_body(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+):
+    """The ONE single-iteration decode body both serve decode programs
+    share: ``step(params, temps, seeds, budgets, extra, carry)`` runs one
+    batched ``forward_decode`` + slot-sampler iteration over the carry
+    ``(kv, tok, pos, stp, fin)`` and returns the updated carry.
+    ``_make_fused_decode`` wraps it in a K-length ``lax.scan``;
+    ``_make_persistent_decode`` wraps the SAME function in a
+    ``lax.while_loop`` — sharing the body is what makes
+    persistent-vs-fused bit-identity hold by construction rather than by
+    parallel maintenance of two copies of the finish/freeze rules."""
+
+    def step(params, temps, seeds, budgets, extra, carry):
+        kv, tok, pos, stp, fin = carry
+        logits, kv = functional_call(
+            model, params, (tok[:, None], kv, pos) + extra,
+            method="forward_decode",
+        )
+        sampled = sampler(logits[:, -1, :], temps, seeds, stp)
+        new_tok = jnp.where(fin, tok, sampled)
+        new_stp = jnp.where(fin, stp, stp + 1)
+        hit_eos = (
+            sampled == eos_token
+            if eos_token is not None
+            else jnp.zeros_like(fin)
+        )
+        hit_len = new_stp >= budgets
+        hit_full = pos + 1 >= max_len  # host's cache_full, pre-clamp
+        new_fin = fin | hit_eos | hit_len | hit_full
+        # the finishing step still advances (the host advances before
+        # it checks), then the position freezes, clamped exactly like
+        # SlotKVCache.positions() clamps a retired slot's
+        new_pos = jnp.where(fin, pos, jnp.clip(pos + 1, 0, max_len - 1))
+        return (kv, new_tok, new_pos, new_stp, new_fin)
+
+    return step
+
+
 def _make_fused_decode(
     model: Any,
     sampler,
@@ -113,10 +156,11 @@ def _make_fused_decode(
 ):
     """Build the serve engine's fused K-step decode program body: a
     ``lax.scan`` of ``decode_chunk`` single-token ``forward_decode`` +
-    slot-sampler iterations carrying the (donated) KV slab, per-slot
-    positions, last tokens, sampler step counters, and an on-device
-    *finished* mask — so the engine crosses the host boundary once per
-    ``K x num_slots`` tokens instead of once per token.
+    slot-sampler iterations (``_make_decode_body``) carrying the
+    (donated) KV slab, per-slot positions, last tokens, sampler step
+    counters, and an on-device *finished* mask — so the engine crosses
+    the host boundary once per ``K x num_slots`` tokens instead of once
+    per token.
 
     The sampler is ``_make_slot_sampler``'s: each emitted token draws
     from ``fold_in(PRNGKey(seeds[b]), steps[b])``, the same
@@ -147,36 +191,110 @@ def _make_fused_decode(
     ``forward_decode`` each step.
     """
 
+    step = _make_decode_body(
+        model, sampler, eos_token=eos_token, max_len=max_len
+    )
+
     def run(params, kv, toks, positions, temps, seeds, steps, budgets,
             finished, *extra):
         def body(carry, _):
-            kv, tok, pos, stp, fin = carry
-            logits, kv = functional_call(
-                model, params, (tok[:, None], kv, pos) + extra,
-                method="forward_decode",
-            )
-            sampled = sampler(logits[:, -1, :], temps, seeds, stp)
-            new_tok = jnp.where(fin, tok, sampled)
-            new_stp = jnp.where(fin, stp, stp + 1)
-            hit_eos = (
-                sampled == eos_token
-                if eos_token is not None
-                else jnp.zeros_like(fin)
-            )
-            hit_len = new_stp >= budgets
-            hit_full = pos + 1 >= max_len  # host's cache_full, pre-clamp
-            new_fin = fin | hit_eos | hit_len | hit_full
-            # the finishing step still advances (the host advances before
-            # it checks), then the position freezes, clamped exactly like
-            # SlotKVCache.positions() clamps a retired slot's
-            new_pos = jnp.where(fin, pos, jnp.clip(pos + 1, 0, max_len - 1))
-            return (kv, new_tok, new_pos, new_stp, new_fin), new_tok
+            carry = step(params, temps, seeds, budgets, extra, carry)
+            return carry, carry[1]  # emit new_tok
 
         (kv, _, _, _, _), toks_block = jax.lax.scan(
             body, (kv, toks, positions, steps, finished), None,
             length=decode_chunk,
         )
         return kv, toks_block
+
+    return run
+
+
+def _make_persistent_decode(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+    ring_capacity: int,
+    stream_cb=None,
+):
+    """Build the serve engine's PERSISTENT decode program: the fused
+    body (``_make_decode_body`` — the same function the K-step scan
+    runs) wrapped in a ``lax.while_loop`` that keeps decoding until a
+    slot-state fixpoint (every slot finished) or the output ring fills,
+    whichever comes first.  One dispatch and ONE host sync (the ring
+    drain) cover a whole generation instead of one per K tokens — the
+    TPU analog of CUDA-graph whole-kernel capture (docs/serving.md).
+
+    The carry holds, on top of the fused carry ``(kv, tok, pos, stp,
+    fin)``, a device-resident output ring: a ``(ring_capacity,
+    num_slots)`` token block, a same-shape *valid* mask (True where the
+    slot was still live when the iteration sampled — the finishing
+    token included, exactly the rows the host is entitled to read), and
+    the write cursor ``it``.  The ring is linear per dispatch — the
+    engine drains it at loop exit and re-enters with fresh state, so a
+    request outliving one ring simply spans drains ("wraparound" is
+    re-entry, not in-loop circular indexing, which would let an
+    unfinished slot overwrite undrained tokens).
+
+    The *initial* finished mask is computed ON DEVICE from the dynamic
+    inputs — ``~active | steps >= budgets`` plus ``toks == eos_token``
+    — because in persistent mode the host defers the prefill token
+    fetch (no per-prefill sync): a first token that is already EOS, or
+    a ``max_new_tokens=1`` budget already spent, must freeze the slot
+    before iteration 0, exactly where the chunked engine's host-side
+    ``_check_finished`` would have retired it at prefill time.  The
+    third host rule, cache-full, must ride in through ``active``
+    itself (the engine ANDs ``pos < max_len`` over the UNCLAMPED host
+    positions): the ``positions`` input here is already clamped to
+    ``max_len - 1`` (``SlotKVCache.positions()``), so a device-side
+    ``pos >= max_len`` test could never fire.
+
+    ``stream_cb`` (optional): called as ``stream_cb(new_tok, live, it)``
+    inside the body — the io_callback/debug-callback streamed tail for
+    first-token latency (``utils.compat``); the ring drain stays the
+    authoritative token path whether or not the stream fires.
+
+    Returns ``run(params, kv, toks, positions, temps, seeds, steps,
+    budgets, active, *extra) -> (kv, ring, valid, iterations)``.
+    """
+
+    step = _make_decode_body(
+        model, sampler, eos_token=eos_token, max_len=max_len
+    )
+
+    def run(params, kv, toks, positions, temps, seeds, steps, budgets,
+            active, *extra):
+        fin0 = (~active) | (steps >= budgets)
+        if eos_token is not None:
+            fin0 = fin0 | (toks == eos_token)
+        ring0 = jnp.zeros((ring_capacity, toks.shape[0]), toks.dtype)
+        valid0 = jnp.zeros((ring_capacity, toks.shape[0]), bool)
+
+        def cond(carry):
+            (_, _, _, _, fin), _, _, it = carry
+            return jnp.logical_and(~jnp.all(fin), it < ring_capacity)
+
+        def body(carry):
+            inner, ring, valid, it = carry
+            live = ~inner[4]  # sampled-this-iteration rows
+            inner = step(params, temps, seeds, budgets, extra, inner)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, inner[1], it, 0
+            )
+            valid = jax.lax.dynamic_update_index_in_dim(valid, live, it, 0)
+            if stream_cb is not None:
+                stream_cb(inner[1], live, it)
+            return (inner, ring, valid, it + 1)
+
+        (kv, _, _, _, _), ring, valid, it = jax.lax.while_loop(
+            cond,
+            body,
+            ((kv, toks, positions, steps, fin0), ring0, valid0,
+             jnp.int32(0)),
+        )
+        return kv, ring, valid, it
 
     return run
 

@@ -7,6 +7,8 @@ radix prefix index for shared-prefix reuse
 (:mod:`~torchdistx_tpu.serve.prefix_cache`), an FCFS scheduler with a
 max-tokens budget, free-page gating, and per-request deadlines
 (:mod:`~torchdistx_tpu.serve.scheduler`), a two-compiled-program engine
+with chunked (fused K-step scan) or persistent (whole-generation
+``lax.while_loop`` + device output ring, host syncs ~0) decode
 (:mod:`~torchdistx_tpu.serve.engine`), and plain-dict metrics
 (:mod:`~torchdistx_tpu.serve.metrics`).
 

@@ -22,6 +22,27 @@ while ALL device work flows through exactly two jitted programs:
    the default ``decode_chunk=1`` this is exactly the classic
    one-token-per-sync decode step.
 
+**Persistent mode** (``decode_mode="persistent"``): the decode program
+becomes ONE ``lax.while_loop`` over the same fused body
+(``generation._make_persistent_decode``) that runs until every slot's
+finish bit is set or the device-resident output ring
+(``(ring_capacity, num_slots)`` tokens + per-iteration valid mask +
+write cursor) fills.  The host crosses the device boundary once per
+*generation wave*, not once per K tokens: prefill defers its
+first-token fetch (the pending device scalar rides along with the next
+ring drain), the drain is the ONE sync (``host_syncs`` counts exactly
+the drains, keeping ``syncs_per_token`` honest — ~0), and
+``_check_finished`` walks the drained ring with the very rules the
+device applied, exactly as it walks the fused ``(K, B)`` block.
+Admission/prefill batch at loop exits, so the scheduler's granularity
+coarsens from the chunk to the loop; retire-to-scratch still holds
+because pages are only ever freed/reallocated at those same loop
+boundaries — a frozen slot's in-loop writes go through the table row
+the loop was dispatched with, which names the slot's own pages (or
+scratch) for the loop's whole lifetime.  The K-step ``chunked`` path
+stays the pinned-bit-identical reference (streams are identical by
+construction: one shared body, one sampler key schedule).
+
 Admitting or retiring a request changes only tiny dynamic inputs
 (positions, temperatures, budgets, a slot index), never a compiled
 shape — the jit cache stays at two programs (plus one per extra bucket
@@ -74,9 +95,11 @@ from ..generation import (
     _cached_jit,
     _check_sampling_args,
     _make_fused_decode,
+    _make_persistent_decode,
     _make_slot_sampler,
 )
 from ..nn.module import functional_call
+from ..utils import compat
 from ..utils.profiling import timed_annotation
 from .kv_cache import (
     PagedKVCache,
@@ -149,6 +172,31 @@ class ServeEngine:
         relay-dominated regime — see docs/serving.md for choosing K);
         the default 1 is the classic one-sync-per-token step.  Each
         distinct value compiles one decode program.
+      decode_mode: ``"chunked"`` (default — the fused K-step scan above,
+        the pinned-bit-identical reference) or ``"persistent"`` — one
+        ``lax.while_loop`` decode program per ``step()`` that runs to a
+        slot-state fixpoint (all slots finished) or a full output ring,
+        draining N host syncs per request into ~1 (docs/serving.md).
+        ``decode_chunk`` is ignored in persistent mode (the loop bound
+        is the ring, not a chunk).
+      ring_capacity: persistent mode's device output ring depth (max
+        loop iterations per dispatch).  Default ``max_len`` — deep
+        enough that any wave of requests finishes inside one loop, so
+        drains track generation waves; shrink it to re-open admission
+        (and deadline checks) more often at the cost of more drains.
+        A request outliving the ring just spans drains.
+      persistent_stream: opt in to the io_callback/debug-callback
+        streamed tail (``utils.compat``): each loop iteration also
+        pushes its ``(tokens, live-mask, cursor)`` to the host, giving
+        first-token timestamps before the drain lands.  Falls back to
+        the pure-drain path silently when this jax has neither callback
+        (``engine.stream_supported`` says which you got); the ring
+        drain stays the authoritative token path either way.  A
+        streaming program is compiled per engine and cached ON the
+        engine (its host sink is the engine; an engine-local program is
+        collected with it instead of pinning it in the model's shared
+        jit store), so sharing a model across streaming engines costs
+        one extra compile each.
       page_size: switch the KV cache to the PAGED layout with pages of
         this many tokens (must divide ``max_len``); ``None`` (default)
         keeps the contiguous per-slot slab.  Paged greedy streams are
@@ -187,6 +235,9 @@ class ServeEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_tokens_in_flight: Optional[int] = None,
         decode_chunk: int = 1,
+        decode_mode: str = "chunked",
+        ring_capacity: Optional[int] = None,
+        persistent_stream: bool = False,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
@@ -221,6 +272,31 @@ class ServeEngine:
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
+        if decode_mode not in ("chunked", "persistent"):
+            raise ValueError(
+                f"decode_mode must be 'chunked' or 'persistent', got "
+                f"{decode_mode!r}"
+            )
+        self.decode_mode = decode_mode
+        self._persistent = decode_mode == "persistent"
+        if self._persistent:
+            if ring_capacity is None:
+                ring_capacity = self.max_len
+            if ring_capacity < 1:
+                raise ValueError(
+                    f"ring_capacity must be >= 1, got {ring_capacity}"
+                )
+            self.ring_capacity: Optional[int] = int(ring_capacity)
+        else:
+            if ring_capacity is not None:
+                raise ValueError(
+                    "ring_capacity requires decode_mode='persistent'"
+                )
+            if persistent_stream:
+                raise ValueError(
+                    "persistent_stream requires decode_mode='persistent'"
+                )
+            self.ring_capacity = None
         if prefill_buckets is None:
             buckets = _default_buckets(self.max_len)
         else:
@@ -270,8 +346,24 @@ class ServeEngine:
                 placement=_kv_placement(self.params),
             )
         self.scheduler = Scheduler(self.num_slots, max_tokens_in_flight)
-        self.metrics = ServeMetrics(self.num_slots, num_pages=self.num_pages)
+        self.metrics = ServeMetrics(
+            self.num_slots,
+            num_pages=self.num_pages,
+            ring_capacity=self.ring_capacity,
+        )
         self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
+        # persistent mode: prefill defers its first-token fetch — the
+        # device scalar parks here (slot -> 0-d array) and materializes
+        # with the next ring drain's single sync
+        self._pending_first: dict = {}
+        # streamed-tail host sink: (monotonic_ts, tokens, live, cursor)
+        # per loop iteration, consumed (and cleared) at each drain
+        self._stream_events: list = []
+        self._stream_cb = None
+        self._stream_program = None  # engine-local jit (see _persistent_program)
+        self.stream_supported: Optional[str] = None
+        if persistent_stream and self._persistent:
+            self._stream_cb = self._build_stream_cb()
         self._last_tok = np.zeros(self.num_slots, np.int32)
         self._temps = np.zeros(self.num_slots, np.float32)
         self._seeds = np.zeros(self.num_slots, np.int32)
@@ -430,7 +522,12 @@ class ServeEngine:
         per-step retrace regression pass the pinned invariant."""
         static = self._static_key()
         total = 0
-        for key, f in self.model.__dict__.get("_serve_jit_cache", {}).items():
+        jits = list(self.model.__dict__.get("_serve_jit_cache", {}).items())
+        if self._stream_program is not None:
+            # the streaming persistent program lives on the ENGINE (its
+            # callback sink is this engine); count it with the rest
+            jits.append((("stream",) + static, self._stream_program))
+        for key, f in jits:
             if key[-len(static):] != static:
                 continue
             cache_size = getattr(f, "_cache_size", None)
@@ -438,6 +535,59 @@ class ServeEngine:
                 return None
             total += int(cache_size())
         return total
+
+    def reset_metrics(self) -> ServeMetrics:
+        """Rebind ``self.metrics`` to a fresh :class:`ServeMetrics` with
+        THIS engine's geometry (slots, pages, ring) — the one correct
+        way to reset between bench passes; hand-constructing the object
+        would silently drop the paged/persistent gauge families."""
+        self.metrics = ServeMetrics(
+            self.num_slots,
+            num_pages=self.num_pages,
+            ring_capacity=self.ring_capacity,
+        )
+        return self.metrics
+
+    # -- streamed tail (persistent mode, opt-in) -------------------------
+
+    def _build_stream_cb(self):
+        """Resolve the best host-callback lowering this jax offers
+        (``utils.compat``): io_callback, else jax.debug.callback, else
+        None — the pure-drain fallback (the loop still runs; the host
+        just learns tokens at drain time only)."""
+        io_cb = compat.get_io_callback()
+        if io_cb is not None:
+            self.stream_supported = "io_callback"
+
+            def stream(tok, live, it):
+                io_cb(self._on_stream, None, tok, live, it, ordered=False)
+
+            return stream
+        dbg_cb = compat.get_debug_callback()
+        if dbg_cb is not None:
+            self.stream_supported = "debug_callback"
+
+            def stream(tok, live, it):
+                dbg_cb(self._on_stream, tok, live, it)
+
+            return stream
+        return None
+
+    def _on_stream(self, toks, live, it) -> None:
+        # host side of the streamed tail.  Runs on a jax runtime thread
+        # mid-loop: append-only + counter bump (GIL-atomic enough); the
+        # drain consumes the buffer under the engine's single-threaded
+        # step() discipline.  Timestamps feed first-token latency; the
+        # ring stays the authoritative token path.
+        self._stream_events.append(
+            (
+                time.monotonic(),
+                np.asarray(toks).copy(),
+                np.asarray(live).copy(),
+                int(it),
+            )
+        )
+        self.metrics.count("stream_callbacks")
 
     # -- the two compiled programs ---------------------------------------
 
@@ -561,6 +711,45 @@ class ServeEngine:
             donate_argnums=(1,),  # kv slab: same aliasing as prefill
         )
 
+    def _persistent_program(self):
+        """The persistent whole-loop decode program
+        (``_make_persistent_decode``): the SAME fused body inside a
+        ``lax.while_loop``, one per ``(ring_capacity, eos_token)``.
+        STREAMING engines cache their program on the engine itself, not
+        in the model's shared jit store: the streamed tail closes over
+        this engine (its host sink), so parking it on the model would
+        pin every discarded streaming engine — KV slab included — for
+        the model's lifetime; an engine-local jit dies with the
+        engine."""
+        if self._stream_cb is not None:
+            if self._stream_program is None:
+                build = _make_persistent_decode(
+                    self.model,
+                    self._sampler,
+                    eos_token=self.eos_token,
+                    max_len=self.max_len,
+                    ring_capacity=self.ring_capacity,
+                    stream_cb=self._stream_cb,
+                )
+                self._stream_program = jax.jit(build, donate_argnums=(1,))
+            return self._stream_program
+        build = _make_persistent_decode(
+            self.model,
+            self._sampler,
+            eos_token=self.eos_token,
+            max_len=self.max_len,
+            ring_capacity=self.ring_capacity,
+            stream_cb=None,
+        )
+        return _cached_jit(
+            self.model,
+            "_serve_jit_cache",
+            ("serve_decode_persistent", self.ring_capacity, self.eos_token)
+            + self._static_key(),
+            build,
+            donate_argnums=(1,),  # kv slab: same aliasing as prefill
+        )
+
     # -- internals -------------------------------------------------------
 
     def _bucket_for(self, length: int) -> int:
@@ -620,29 +809,41 @@ class ServeEngine:
         else:
             tok = self._dispatch_prefill_slab(req, slot)
         self.cache.admit(slot, req.prompt.size)
-        self._last_tok[slot] = tok
         self._temps[slot] = req.temperature
         self._seeds[slot] = req.seed
         self._ntok[slot] = 1
         self._budget[slot] = req.max_new_tokens
         now = time.monotonic()
-        req.first_token_at = now
-        req.record_event("first_token", ts=now)
-        req.generated.append(tok)
-        self.metrics.count("host_syncs")
         self.metrics.count("prefill_calls")
         self.metrics.count("requests_admitted")
-        self.metrics.count("tokens_generated")
-        # aggregate histograms are fed from the request's OWN lifecycle
-        # timestamps (not a second clock read), so the per-request view
-        # (RequestResult.ttft_s / queue_wait_s, the Perfetto request
-        # track) and these aggregates provably agree — pinned in
-        # tests/test_obs.py
-        self.metrics.ttft_s.record(req.first_token_at - req.submitted_at)
         self.metrics.queue_wait_s.record(
             (req.admitted_at or now) - req.submitted_at
         )
+        if self._persistent:
+            # NO host sync here: the device scalar parks until the next
+            # ring drain (the loop program recomputes the finish bit
+            # on-device, so an EOS/instantly-over-budget first token
+            # still freezes its slot before iteration 0)
+            self._pending_first[slot] = tok
+            return
+        self.metrics.count("host_syncs")  # the dispatch's token fetch
+        self._record_first(req, tok, now)
         self._check_finished(req, tok, now)
+
+    def _record_first(self, req: Request, tok: int, now: float) -> None:
+        """First-token bookkeeping shared by the chunked path (at
+        prefill, post-sync) and the persistent path (at drain, or at a
+        pre-drain deadline flush).  The aggregate histograms are fed
+        from the request's OWN lifecycle timestamps (not a second clock
+        read), so the per-request view (RequestResult.ttft_s, the
+        Perfetto request track) and the aggregates provably agree —
+        pinned in tests/test_obs.py."""
+        self._last_tok[req.slot] = tok
+        req.first_token_at = now
+        req.record_event("first_token", ts=now)
+        req.generated.append(tok)
+        self.metrics.count("tokens_generated")
+        self.metrics.ttft_s.record(req.first_token_at - req.submitted_at)
 
     def _dispatch_prefill_slab(self, req: Request, slot: int) -> int:
         bucket = self._bucket_for(req.prompt.size)
@@ -664,7 +865,8 @@ class ServeEngine:
             # slab, so if the sync raises (wedged relay) the engine must
             # already hold the live output, not a deleted buffer
             self.cache.kv = kv
-            tok = int(np.asarray(tok))  # host sync: the first token exists
+            if not self._persistent:  # persistent defers to the drain
+                tok = int(np.asarray(tok))  # host sync: first token exists
         self.metrics.count("tokens_prefilled", bucket)
         return tok
 
@@ -699,7 +901,8 @@ class ServeEngine:
         with timed_annotation("serve/prefill", self.metrics.prefill_s.record):
             kv, tok = program(*args)
             self.cache.kv = kv  # before the sync: the pools were donated
-            tok = int(np.asarray(tok))
+            if not self._persistent:  # persistent defers to the drain
+                tok = int(np.asarray(tok))
         # only the suffix bucket was computed — the prefix hit is the
         # prefill compute (and token) the cache saved
         self.metrics.count("tokens_prefilled", bucket)
@@ -722,6 +925,8 @@ class ServeEngine:
         carries agree step for step; tokens a request emitted after its
         own finish never exist on the host side, and the slot-steps the
         device masked out are accounted in ``masked_slot_steps``."""
+        if self._persistent:
+            return self._persistent_step()
         running = self.scheduler.running
         k_steps = self.decode_chunk
         program = self._decode_program()
@@ -779,6 +984,123 @@ class ServeEngine:
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
 
+    def _persistent_step(self) -> None:
+        """One persistent-loop dispatch: the while_loop runs on-device
+        until every slot's finish bit sets or the ring fills, then the
+        host drains the ring — ONE sync for the whole wave, the pending
+        prefill first-tokens riding along.  The drained walk applies the
+        exact ``_check_finished`` rules the device's finish mask did
+        (the valid mask bounds the walk: True exactly on the rows a live
+        slot sampled, the finishing token included), so host bookkeeping
+        and device carries agree iteration for iteration.  A request the
+        ring cut off (budget-bound exit) simply stays running and
+        continues from its frozen carry at the next dispatch — spanning
+        drains is the persistent analog of spanning chunks."""
+        running = self.scheduler.running
+        program = self._persistent_program()
+        toks = jnp.asarray(self._last_tok)
+        for slot, dev_tok in self._pending_first.items():
+            # freshly prefilled slots: their first token exists only on
+            # device; splice it into the loop's last-token row without a
+            # fetch (a tiny host-staged update, no sync).  The index is
+            # ARRAY-typed on purpose: a python-int index is a static
+            # value baked into the scatter executable, so each distinct
+            # slot would compile its own op — a per-slot recompile the
+            # recompile watcher flags in the bench's measured window
+            toks = toks.at[jnp.asarray(slot, jnp.int32)].set(dev_tok)
+        args = [
+            self.params,
+            self.cache.kv,
+            toks,
+            jnp.asarray(self.cache.positions()),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+            jnp.asarray(self._ntok),
+            jnp.asarray(self._budget),
+            # the active mask carries the cache-full rule: positions()
+            # is clamped to max_len - 1, so the room check must come
+            # from the UNCLAMPED host positions or it could never fire
+            # (_make_persistent_decode docstring)
+            jnp.asarray(self.cache.active & (self.cache.pos < self.max_len)),
+        ]
+        if self.paged:
+            # scan-invariant within the loop: pages are only ever freed
+            # or reallocated host-side at drain boundaries, so no frozen
+            # in-loop write can land on a page this table doesn't own
+            args.append(jnp.asarray(self.cache.page_tables))
+        self._stream_events.clear()
+        with timed_annotation(
+            "serve/decode", self.metrics.decode_s.record
+        ) as timing:
+            kv, ring, valid, iters = program(*args)
+            self.cache.kv = kv  # before the sync: old slab was donated
+            # ONE host sync drains the ring, the valid mask, the cursor,
+            # and every pending first token together
+            block, vmask, n_it, firsts = jax.device_get(
+                (ring, valid, iters, dict(self._pending_first))
+            )
+        n_it = int(n_it)
+        self._pending_first.clear()
+        self.metrics.count("host_syncs")  # the drain IS the sync
+        self.metrics.count("ring_drains")
+        self.metrics.count("decode_dispatches")
+        self.metrics.count("decode_steps", n_it)
+        self.metrics.count("loop_iterations", n_it)
+        self.metrics.observe_ring(n_it)
+        now = time.monotonic()
+        # streamed tail (opt-in): the iteration-0 callback timestamp is
+        # when the wave's first tokens actually existed host-side —
+        # tighter than the drain time for first-token latency
+        first_ts = now
+        if self._stream_events:
+            first_ts = min(now, self._stream_events[0][0])
+        emitted = 0
+        any_cut = False
+        for req in running:
+            slot = req.slot
+            taken = 0
+            finished = False
+            if slot in firsts:
+                tok = int(firsts[slot])
+                self._record_first(req, tok, first_ts)
+                if self._check_finished(req, tok, first_ts):
+                    # the device's fin0 froze this slot before iteration
+                    # 0 (EOS first token / one-token budget): it idled
+                    # the whole loop
+                    finished = True
+            if not finished:
+                for j in range(n_it):
+                    if not vmask[j, slot]:
+                        break  # frozen from here on: rows are rewrites
+                    tok = int(block[j, slot])
+                    self._ntok[slot] += 1
+                    self.cache.advance_slot(slot)
+                    self._last_tok[slot] = tok
+                    req.generated.append(tok)
+                    emitted += 1
+                    taken = j + 1
+                    if self._check_finished(req, tok, now):
+                        finished = True
+                        break
+            if finished:
+                # iterations the loop kept running past this slot's
+                # finish — the persistent analog of mid-chunk waste
+                self.metrics.count("masked_slot_steps", n_it - taken)
+            else:
+                any_cut = True  # ring filled before this request's end
+            ev = ("decode_chunk", now, {"tokens": taken})
+            if req.events and req.events[-1][0] == "finish":
+                # keep the lifecycle log causal (chunk, then finish)
+                req.events.insert(-1, ev)
+            else:
+                req.events.append(ev)
+        if any_cut:
+            self.metrics.count("ring_full_drains")
+        self.metrics.count("tokens_generated", emitted)
+        self.metrics.count("tokens_decoded", emitted)
+        if emitted:
+            self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+
     def _check_finished(self, req: Request, tok: int, now: float) -> bool:
         if self.eos_token is not None and tok == self.eos_token:
             self._finish(req, "stop", now)
@@ -794,6 +1116,15 @@ class ServeEngine:
 
     def _finish(self, req: Request, reason: str, now: float) -> None:
         slot = req.slot
+        pending = self._pending_first.pop(slot, None)
+        if pending is not None:
+            # rare pre-drain exit (deadline expiry between prefill and
+            # the first drain): the prefill DID sample a token — flush
+            # it so the truncated result matches what the chunked
+            # engine would have returned, at the cost of one sync
+            tok = int(np.asarray(pending))
+            self.metrics.count("host_syncs")
+            self._record_first(req, tok, now)
         self.scheduler.retire(req)
         self.cache.retire(slot)  # paged: also rewires the table to scratch
         if self.paged and req.pages is not None:

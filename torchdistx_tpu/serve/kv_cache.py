@@ -20,6 +20,15 @@ In both, admitting/retiring a request changes only tiny dynamic inputs
 compiled prefill/decode programs survive any admit/retire sequence: the
 property the whole engine is built on.
 
+The same invariance is what lets the persistent decode loop
+(``decode_mode="persistent"``) freeze a finished slot ON DEVICE for an
+arbitrary number of while-loop iterations: the host only frees pages,
+rewrites table rows, or flips ``active`` bits at drain boundaries
+(between loop dispatches), so within any one dispatch the table input
+is loop-invariant — a frozen slot's in-loop rewrites land in pages its
+table owned when the loop launched, or (once retired at a previous
+drain) on the scratch page, never on a page reallocated mid-loop.
+
 Stale-row safety (paged): a freed page's old K/V rows are NOT zeroed.
 They are unreachable by construction — a page is freed only when its
 refcount reaches zero, i.e. no live page table references it (retiring a

@@ -91,14 +91,26 @@ class ServeMetrics:
     token, THE number the fused decode loop exists to shrink),
     ``masked_slot_steps`` (slot-steps the on-device finish mask threw
     away because a request finished mid-chunk: the wasted-work side of
-    the host-sync tradeoff), and the prefix-cache set —
+    the host-sync tradeoff), the persistent-loop set —
+    ``loop_iterations`` (on-device while_loop iterations across all
+    persistent dispatches — equals ``decode_steps`` in persistent mode),
+    ``ring_drains`` (loop exits whose output ring the host drained; in
+    persistent mode every drain is also exactly one ``host_syncs``
+    increment, which is what keeps ``syncs_per_token`` honest),
+    ``ring_full_drains`` (drains where the ring filled before every
+    slot finished — at least one request spans into the next loop), and
+    ``stream_callbacks`` (streamed-tail host callbacks, opt-in) — and
+    the prefix-cache set —
     ``prefix_lookup_tokens`` / ``prefix_hit_tokens`` (prompt tokens
     looked up in the radix index vs served from it; their ratio is the
     derived ``prefix_hit_rate``) and ``pages_evicted`` (LRU evictions
     from the prefix index under pool pressure).
     Gauges: ``queue_depth``, ``active_slots``; paged engines add
     ``pages_in_use`` / ``pages_in_use_hwm`` (current and high-water
-    allocated pages) and ``num_pages``.
+    allocated pages) and ``num_pages``; persistent engines add
+    ``ring_capacity`` and ``ring_occupancy_hwm`` (high-water loop
+    iterations a single dispatch used — at the capacity it means rings
+    are filling and requests span drains).
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``tpot_s`` (per finished
     request: decode seconds per token after the first — the
@@ -128,9 +140,17 @@ class ServeMetrics:
         "decode_token_s",
     )
 
-    def __init__(self, num_slots: int, num_pages: Optional[int] = None):
+    def __init__(
+        self,
+        num_slots: int,
+        num_pages: Optional[int] = None,
+        ring_capacity: Optional[int] = None,
+    ):
         self.num_slots = int(num_slots)
         self.num_pages = num_pages if num_pages is None else int(num_pages)
+        self.ring_capacity = (
+            ring_capacity if ring_capacity is None else int(ring_capacity)
+        )
         self.started_at = time.monotonic()
         self.counters: Dict[str, int] = {
             "requests_submitted": 0,
@@ -145,6 +165,10 @@ class ServeMetrics:
             "decode_dispatches": 0,
             "host_syncs": 0,
             "masked_slot_steps": 0,
+            "loop_iterations": 0,
+            "ring_drains": 0,
+            "ring_full_drains": 0,
+            "stream_callbacks": 0,
             "prefix_lookup_tokens": 0,
             "prefix_hit_tokens": 0,
             "pages_evicted": 0,
@@ -153,6 +177,7 @@ class ServeMetrics:
         self.active_slots = 0
         self.pages_in_use = 0
         self.pages_in_use_hwm = 0
+        self.ring_occupancy_hwm = 0
         self.ttft_s = Histogram()
         self.e2e_latency_s = Histogram()
         self.queue_wait_s = Histogram()
@@ -178,6 +203,12 @@ class ServeMetrics:
         self.pages_in_use = in_use
         self.pages_in_use_hwm = max(self.pages_in_use_hwm, in_use)
 
+    def observe_ring(self, iterations: int) -> None:
+        """Persistent engines only: loop iterations one dispatch used.
+        Same reset rationale as :meth:`observe_pages` — the high-water
+        mark lives on this metrics object, not the engine."""
+        self.ring_occupancy_hwm = max(self.ring_occupancy_hwm, iterations)
+
     def to_json(self) -> dict:
         """The one structured, JSON-serializable schema tests, bench, and
         CI all parse: ``{"counters", "gauges", "histograms", "derived"}``
@@ -194,6 +225,9 @@ class ServeMetrics:
             gauges["num_pages"] = self.num_pages
             gauges["pages_in_use"] = self.pages_in_use
             gauges["pages_in_use_hwm"] = self.pages_in_use_hwm
+        if self.ring_capacity is not None:
+            gauges["ring_capacity"] = self.ring_capacity
+            gauges["ring_occupancy_hwm"] = self.ring_occupancy_hwm
         wall = time.monotonic() - self.started_at
         # decode-only tokens over decode-only time: prefill's sampled
         # token rides a prefill dispatch, so counting it here would

@@ -11,7 +11,11 @@ version boundary in one place.
 The observability layer adds two more drift-prone surfaces tracked
 here: the private jit ``_cache_size`` introspection
 (:func:`jit_cache_size`) and the ``jax.monitoring`` compile-event hook
-(:func:`register_compile_listener`) behind ``obs.recompile``.
+(:func:`register_compile_listener`) behind ``obs.recompile``.  The
+persistent decode loop adds the host-callback pair
+(:func:`get_io_callback` / :func:`get_debug_callback`) — availability
+probes returning None on drifted jax, with the engine falling back to
+its pure ring-drain path when both are absent.
 
 Lives under ``utils`` so leaf consumers (``ops.attention``, the model
 forwards) can use ``axis_size`` without importing the parallel package —
@@ -37,6 +41,8 @@ __all__ = [
     "axis_size",
     "jit_cache_size",
     "register_compile_listener",
+    "get_io_callback",
+    "get_debug_callback",
 ]
 
 
@@ -74,6 +80,34 @@ def jit_cache_size(fn):
         return int(cache_size())
     except Exception:
         return None
+
+
+def get_io_callback():
+    """``jax.experimental.io_callback`` or None when this jax lacks it.
+
+    ``io_callback`` has lived in ``jax.experimental`` since 0.4.x but is
+    still export-drift-prone (this container pins 0.4.37; newer jax may
+    promote or rename it).  The persistent decode loop
+    (``serve.engine``) uses it only for the OPTIONAL token-streaming
+    tail — None means "stream unavailable", and every consumer must
+    fall back to the pure ring-drain path, never error."""
+    try:
+        from jax.experimental import io_callback
+    except ImportError:
+        return None
+    return io_callback
+
+
+def get_debug_callback():
+    """``jax.debug.callback`` or None.  The streaming tail's second
+    choice (debug effects are the most control-flow-tolerant callback
+    lowering); same None-means-fall-back-to-drain contract as
+    :func:`get_io_callback`."""
+    try:
+        from jax import debug
+    except ImportError:
+        return None
+    return getattr(debug, "callback", None)
 
 
 def register_compile_listener(cb) -> bool:
