@@ -262,15 +262,39 @@ def _run_kernel_sweep(timeout_s: float) -> dict:
     return _run_phase("", timeout_s, script=script, env=env)
 
 
+def _ledger():
+    """Load ``torchdistx_tpu/obs/ledger.py`` WITHOUT importing the
+    package: the supervising parent never touches jax or the native
+    build, and the ledger module is stdlib-only by design.  Memoized in
+    ``sys.modules`` so per-emit calls share one module instance (and
+    its git-sha cache: one subprocess per run, not per phase emit)."""
+    import importlib.util
+    import sys
+
+    mod = sys.modules.get("_tdx_ledger")
+    if mod is not None:
+        return mod
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "torchdistx_tpu", "obs", "ledger.py")
+    spec = importlib.util.spec_from_file_location("_tdx_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sys.modules["_tdx_ledger"] = mod
+    return mod
+
+
 def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
-            progress: str, kernels: dict, train_fused: dict) -> str:
+            progress: str, kernels: dict, train_fused: dict) -> dict:
     """Assemble the (always-parseable) bench record from whatever ran."""
     train = dict(train)
     eager_ok = "total_s" in eager
     total = eager.get("total_s")
-    return json.dumps(
+    return (
         {
             "metric": "deferred_init_materialize_llama2_7b_wall_s",
+            # commit + schema attribution (perf-sentinel satellite: runs
+            # were previously unattributable to commits)
+            **_ledger().record_stamp(),
             "value": round(total, 3) if eager_ok else None,
             "unit": "s",
             "vs_baseline": round(60.0 / total, 3) if eager_ok else None,
@@ -330,9 +354,10 @@ def main() -> None:
     def emit(train, eager, chunked, preflight, progress, kernels,
              train_fused=None):
         # one full parseable record per phase boundary; last line wins
-        print(_record(train, eager, chunked, preflight, progress, kernels,
-                      train_fused if train_fused is not None else pending),
-              flush=True)
+        rec = _record(train, eager, chunked, preflight, progress, kernels,
+                      train_fused if train_fused is not None else pending)
+        print(json.dumps(rec), flush=True)
+        return rec
 
     # First record before ANY device contact: even a kill during the very
     # first phase leaves a parseable tail.
@@ -351,7 +376,10 @@ def main() -> None:
             "(last known-good on-chip record: BENCH_r03_local.json)",
         )
         skip = {"skipped": "relay wedged at preflight"}
-        emit(skip, skip, skip, preflight, "preflight-failed", skip)
+        rec = emit(skip, skip, skip, preflight, "preflight-failed", skip)
+        # even the wedged round joins the ledger — as quality=degraded,
+        # recorded but never a baseline (the r04/r05 honesty rule)
+        _ledger().append_record_rows(rec, source="bench")
         return
 
     # Every phase runs in its own process: each nearly fills the 16 GB
@@ -396,8 +424,11 @@ def main() -> None:
         min(450.0, left()),
         env=dict(os.environ, TDX_BENCH_FUSED_CE="1"),
     )
-    emit(train, eager, chunked, preflight, "complete", kernels,
-         train_fused)
+    rec = emit(train, eager, chunked, preflight, "complete", kernels,
+               train_fused)
+    # perf-sentinel hook: the finished record lands in LEDGER.jsonl as
+    # normalized per-metric rows (never raises; TDX_LEDGER=0 disables)
+    _ledger().append_record_rows(rec, source="bench")
 
 
 if __name__ == "__main__":

@@ -132,12 +132,15 @@ def main():
     args = ap.parse_args()
     import jax
 
+    from torchdistx_tpu.obs.ledger import record_stamp
+
+    stamp = record_stamp()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(config1()))
-        print(json.dumps(config3()))
+        print(json.dumps({**stamp, **config1()}))
+        print(json.dumps({**stamp, **config3()}))
     else:
-        print(json.dumps(config2(args.replay_mode)))
+        print(json.dumps({**stamp, **config2(args.replay_mode)}))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torchdistx_tpu.obs.ledger import record_stamp as _stamp
 from torchdistx_tpu.ops.attention import multihead_attention
 from torchdistx_tpu.ops.flash_attention import flash_attention
 
@@ -101,7 +102,7 @@ def bias_rows(seqs):
             grads = jax.grad(biased_loss, (0, 1, 2, 3))(q, k, v, bias)
             return sum(g.mean().astype(jnp.float32) for g in grads)
 
-        row = {"seq": seq, "bias": True}
+        row = {"seq": seq, "bias": True, **_stamp()}
         for name, forced in (("kernel_bwd", False), ("chunked_bwd", True)):
             fa._FORCE_CHUNKED_BWD = forced
             try:
@@ -177,7 +178,7 @@ def main():
             )(q, k, v)
             return sum(g.mean().astype(jnp.float32) for g in grads)
 
-        row = {"seq": seq}
+        row = {"seq": seq, **_stamp()}
         for name, fn, fwd_only in (
             ("ref_fwd", ref_fwd, True),
             ("flash_fwd", flash_fwd, True),

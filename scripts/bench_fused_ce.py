@@ -95,7 +95,10 @@ def main() -> None:
         y = jax.random.randint(ks[2], (n,), 0, v)
         t_un = timed(unfused, x, w, y, args.iters)
         t_fu = timed(fused, x, w, y, args.iters)
+        from torchdistx_tpu.obs.ledger import record_stamp
+
         print(json.dumps({
+            **record_stamp(),
             "shape": spec,
             "unfused_ms": round(t_un * 1e3, 3),
             "fused_ms": round(t_fu * 1e3, 3),

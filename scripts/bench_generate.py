@@ -69,7 +69,10 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     toks = args.batch * args.new_tokens
+    from torchdistx_tpu.obs.ledger import record_stamp
+
     print(json.dumps({
+        **record_stamp(),
         "model": name,
         "quantized": args.quantize,
         "param_bytes_gb": round(n_bytes / 1e9, 3),

@@ -55,6 +55,28 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _ledger():
+    """Load ``torchdistx_tpu/obs/ledger.py`` WITHOUT importing the
+    package: the supervising parent must never pull in jax or the
+    native build (the parent-never-touches-the-device rule), and the
+    ledger module is stdlib-only by design.  Memoized in ``sys.modules``
+    so repeat calls share one module instance (and its git-sha cache)."""
+    import importlib.util
+
+    mod = sys.modules.get("_tdx_ledger")
+    if mod is not None:
+        return mod
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "torchdistx_tpu", "obs", "ledger.py",
+    )
+    spec = importlib.util.spec_from_file_location("_tdx_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sys.modules["_tdx_ledger"] = mod
+    return mod
+
+
 def _parse_args():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=32)
@@ -170,6 +192,9 @@ def _supervise(args) -> None:
         chunks = [1] + ([chunks[-1]] if chunks[-1] != 1 else [])
     record: dict = {
         "bench": "serve",
+        # commit + schema attribution (the perf-sentinel requirement:
+        # a record that can't name its sha can't join the trajectory)
+        **_ledger().record_stamp(),
         "model": os.environ.get("TDX_SERVE_MODEL", "llama_1b"),
         "deadline_s": deadline,
         "decode_chunks": chunks,
@@ -258,6 +283,10 @@ def _supervise(args) -> None:
         emit()  # full record after EVERY phase — the consumer contract
 
     _write_artifact(record)
+    # perf-sentinel hook: normalize this run into LEDGER.jsonl rows so
+    # the trajectory (and the nightly gate's baselines) grow with every
+    # run — never raises, disabled by TDX_LEDGER=0
+    _ledger().append_record_rows(record, source="bench_serve")
     failed = [
         name
         for name, p in sorted(record["phases"].items())

@@ -108,7 +108,10 @@ def main() -> None:
     toks = n_steps * batch * seq
     tokens_per_sec = toks / dt
     flops_per_token = 6 * n_params
+    from torchdistx_tpu.obs.ledger import record_stamp
+
     print(json.dumps({
+        **record_stamp(),
         "model": name,
         "params": int(n_params),
         "batch": batch,

@@ -27,6 +27,9 @@ Usage:
     (standalone flight-record validation — the nightly crash-injection
     smoke's gate; with --expect-rollback the record must also contain a
     rollback entry naming the restored step and checkpoint)
+  python scripts/check_obs_artifacts.py --ledger LEDGER.jsonl
+    (tdx-ledger-v1 schema validation: every line must parse and every
+    row must validate — the perf-sentinel half of the nightly gate)
 """
 
 from __future__ import annotations
@@ -152,9 +155,32 @@ def _check_flight_main(argv: list) -> None:
     print(f"flight records OK ({len(paths)} file(s))")
 
 
+def _check_ledger_main(paths: list) -> None:
+    from torchdistx_tpu.obs.ledger import validate_ledger_file
+
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    for p in paths:
+        errs = validate_ledger_file(p)
+        errors.extend(errs)
+        if not errs:
+            with open(p) as f:
+                n = sum(1 for ln in f if ln.strip())
+            print(f"ledger {p}: {n} rows")
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"ledger OK ({len(paths)} file(s))")
+
+
 def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--flight":
         _check_flight_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--ledger":
+        _check_ledger_main(sys.argv[2:])
         return
     if len(sys.argv) != 2:
         raise SystemExit(__doc__)

@@ -43,6 +43,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_PATH = os.path.join(REPO, "CAMPAIGN.json")
 
 
+def _ledger():
+    """Load ``torchdistx_tpu/obs/ledger.py`` WITHOUT importing the
+    package: the campaign driver runs every TPU step as a subprocess
+    and must never touch jax itself; the ledger module is stdlib-only
+    by design.  Memoized in ``sys.modules`` so repeat calls share one
+    module instance (and its git-sha cache)."""
+    import importlib.util
+
+    mod = sys.modules.get("_tdx_ledger")
+    if mod is not None:
+        return mod
+    path = os.path.join(REPO, "torchdistx_tpu", "obs", "ledger.py")
+    spec = importlib.util.spec_from_file_location("_tdx_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sys.modules["_tdx_ledger"] = mod
+    return mod
+
+
 def _steps() -> list:
     py = sys.executable
     bench = os.path.join(REPO, "bench.py")
@@ -121,10 +140,14 @@ def main() -> None:
             )
 
     results: dict = {}
+    # commit + schema attribution, stamped once at campaign start (the
+    # perf-sentinel satellite: every emitter names its producing sha)
+    stamp = _ledger().record_stamp()
 
     def write(status: str) -> None:
         with open(OUT_PATH, "w") as f:
-            json.dump({"status": status, "steps": results}, f, indent=1)
+            json.dump({"status": status, **stamp, "steps": results}, f,
+                      indent=1)
         print(json.dumps({"campaign": status,
                           "done": list(results)}), flush=True)
 
@@ -179,7 +202,17 @@ def main() -> None:
             wedged = True
         write("running")
     skipped = [n for n, v in results.items() if "skipped" in v]
-    write("wedged" if wedged else ("partial" if skipped else "complete"))
+    status = "wedged" if wedged else ("partial" if skipped else "complete")
+    write(status)
+    # perf-sentinel hook: per-step rc/wall rows, plus KILLED bench /
+    # bench_serve steps' harvested tails, normalized into LEDGER.jsonl
+    # (never raises; TDX_LEDGER=0 disables).  Gracefully-exited bench /
+    # bench_serve steps appended their own rows in-process; the ad-hoc
+    # per-script emitters (generate/t5/flash/fused_ce) have no ledger
+    # family and ride only as step rc/wall rows
+    _ledger().append_record_rows(
+        {"status": status, **stamp, "steps": results}, source="campaign"
+    )
 
 
 if __name__ == "__main__":

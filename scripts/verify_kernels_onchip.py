@@ -377,12 +377,28 @@ def _write_record(preflight, phase_status, cases, progress, path, mode):
     for provisional/degraded states that must not clobber a prior
     compiled artifact — parents harvest stdout either way)."""
     n_ok = sum(1 for c in cases if c.get("ok"))
+    # standalone-load the (stdlib-only) ledger module: the supervising
+    # parent must not import the package (jax + native build); memoized
+    # in sys.modules so per-phase record writes share one instance
+    import importlib.util
+
+    _ledger = sys.modules.get("_tdx_ledger")
+    if _ledger is None:
+        spec = importlib.util.spec_from_file_location(
+            "_tdx_ledger",
+            os.path.join(REPO, "torchdistx_tpu", "obs", "ledger.py"),
+        )
+        _ledger = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_ledger)
+        sys.modules["_tdx_ledger"] = _ledger
+
     record = {
         # interpret-mode smoke runs get a distinct metric name so no
         # consumer can mistake them for compiled-Mosaic acceptance
         "metric": ("flash_kernel_onchip_acceptance"
                    if mode == "compiled-mosaic"
                    else "flash_kernel_interpret_smoke"),
+        **_ledger.record_stamp(),
         "mode": mode,
         "progress": progress,
         "preflight": preflight,

@@ -29,10 +29,38 @@ PR 5 adds the *training-side* layer on the same substrate
 - :mod:`~torchdistx_tpu.obs.flight` — bounded flight-recorder ring with
   per-event-flush streaming and atomic crash dumps (the NCCL flight
   recorder analog).
+
+PR 7 adds the *perf sentinel* — the layer that reads the evidence back
+(docs/observability.md "Perf sentinel"):
+
+- :mod:`~torchdistx_tpu.obs.ledger` — schema-versioned
+  (``tdx-ledger-v1``) append-only JSONL benchmark ledger with ingest
+  adapters for every artifact family; counter rows are deterministic,
+  timing rows are noisy, degraded runs are recorded but never baseline.
+- :mod:`~torchdistx_tpu.obs.gate` — expectations-driven regression
+  gate: exact compare for counters, direction-aware tolerance bands
+  for timings (``scripts/perf_gate.py`` is the CI entry point;
+  ``scripts/perf_report.py`` renders trends and A/B deltas).
 """
 
 from .comm import CommProfile, comm_audit, record_collective
 from .flight import FlightRecorder, get_flight_recorder
+from .gate import (
+    build_expectations,
+    gate_rows,
+    render_gate_markdown,
+    timing_direction,
+)
+from .ledger import (
+    append_record_rows,
+    append_rows,
+    ingest_artifact,
+    make_row,
+    read_ledger,
+    record_stamp,
+    validate_ledger_file,
+    validate_ledger_row,
+)
 from .memory import hbm_watermark, memory_report, sharding_report
 from .metrics import (
     Counter,
@@ -55,6 +83,18 @@ from .trace import (
 )
 
 __all__ = [
+    "append_record_rows",
+    "append_rows",
+    "build_expectations",
+    "gate_rows",
+    "ingest_artifact",
+    "make_row",
+    "read_ledger",
+    "record_stamp",
+    "render_gate_markdown",
+    "timing_direction",
+    "validate_ledger_file",
+    "validate_ledger_row",
     "Tracer",
     "get_tracer",
     "enable_tracing",
