@@ -97,6 +97,31 @@ def _train_throughput():
     toks = n_steps * w["batch"] * w["seq"]
     tokens_per_sec = toks / dt
     mfu = tokens_per_sec * w["flops_per_token"] / _PEAK
+
+    # cost observatory (obs.cost): card the train program AFTER the
+    # timed window (the card's own compile must not pollute it), then
+    # attribute the analytic FLOP model against XLA's count and report
+    # the timed span's MFU from BOTH — the formula-vs-compiler check
+    # that would have caught the round-3 ~0.87x-of-formula finding as a
+    # number instead of a trace-reading session.  TDX_COST_CARDS=0
+    # skips (one extra whole-program compile).
+    cost_card = None
+    mfu_xla = None
+    from torchdistx_tpu.obs.cost import compute_cost_card, force_disabled
+
+    if not force_disabled():
+        try:
+            card = compute_cost_card(
+                run, carry, name="train/step",
+                analytic_flops=float(w["flops_per_token"]) * toks,
+            )
+            cost_card = card.to_json()
+            if card.flops:
+                # the whole `run` program is n_steps steps: per-span MFU
+                # over the same dt the analytic mfu used
+                mfu_xla = round(card.flops / (dt * _PEAK), 4)
+        except Exception as e:
+            cost_card = {"error": f"{type(e).__name__}: {e}"[:200]}
     # goodput: the timed window's productive fraction of the phase —
     # everything else is warmup/compile (the donated-carry tax made
     # visible as a ratio, not just a warm-call list)
@@ -126,6 +151,10 @@ def _train_throughput():
         # the watcher's counters back that flag with numbers: compiles
         # attributed to warm-up vs the timed window (window must be 0)
         "train_recompile": watcher.snapshot(),
+        # the card + the XLA-counted span MFU ride next to the analytic
+        # mfu; their ratio is cost_card["flop_attribution"]
+        "train_cost_card": cost_card,
+        "mfu_xla": mfu_xla,
         "train_window_s": round(dt, 3),
         "train_final_loss": round(final_loss, 4)
         if math.isfinite(final_loss)
@@ -180,14 +209,24 @@ def _materialize_7b(replay_mode: str) -> dict:
 
 
 def _preflight() -> dict:
-    """Tiny matmul to prove the device relay answers at all."""
+    """Tiny matmul to prove the device relay answers at all.
+
+    A dispatch-stall watchdog (obs.watchdog) arms around the matmul at
+    just under the supervising 75 s kill: a wedged relay then leaves a
+    flight dump naming ``preflight/matmul`` BEFORE the subprocess dies
+    — the r04/r05 rounds produced no artifact at all from exactly this
+    hang."""
     _set_platform()
     import jax
     import jax.numpy as jnp
 
+    from torchdistx_tpu.obs.watchdog import DispatchWatchdog
+
+    watchdog = DispatchWatchdog(60.0)
     t0 = time.time()
-    x = jnp.ones((512, 512), jnp.bfloat16)
-    jax.block_until_ready(x @ x)
+    with watchdog.arm("preflight/matmul"):
+        x = jnp.ones((512, 512), jnp.bfloat16)
+        jax.block_until_ready(x @ x)
     return {"ok": True, "preflight_s": round(time.time() - t0, 2),
             "device": str(jax.devices()[0])}
 

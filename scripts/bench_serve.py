@@ -376,6 +376,73 @@ def _phase_setup(args, **extra) -> tuple:
     return record, name, k_chunk, plat
 
 
+def _embed_cost(record: dict, engine) -> None:
+    """Cost-observatory fields of one phase record (obs.cost): the
+    per-program CostCards (ledger counter rows + the --cost CI schema
+    check read these), the live HBM capacity plan the admission gate
+    consults, and per-span roofline/MFU attribution — prefill and
+    decode each get their own measured MFU instead of one end-of-run
+    number.  A span's MFU is only computed when ONE program served it
+    (several prefill buckets mixing would attribute dishonestly) and a
+    chip peak is known (None on the CPU smoke, by design).  The
+    persistent while-loop program's XLA FLOP count covers ONE loop
+    body, so its executions count is ``loop_iterations`` (bodies run),
+    not ``decode_dispatches`` (ring drains) — using drains would
+    understate MFU by the iterations-per-drain factor; the remaining
+    per-dispatch caveat is flagged in the entry's note."""
+    from torchdistx_tpu.obs.cost import span_mfu
+    from torchdistx_tpu.utils.benchmarks import V5E_PEAK_BF16
+
+    record["cost_cards"] = engine.cost_book.to_json()
+    record["memory_plan"] = engine.memory_plan()
+    peak = V5E_PEAK_BF16 if record.get("platform") == "tpu" else None
+    m = engine.metrics
+    cards = engine.cost_book.cards()
+    spans = {}
+    groups = {
+        "prefill": (
+            "serve/prefill",
+            m.counters["prefill_calls"],
+            m.prefill_s.total,
+        ),
+        "decode": (
+            "serve/decode",
+            m.counters["decode_dispatches"],
+            m.decode_s.total,
+        ),
+    }
+    for span, (prefix, execs, secs) in groups.items():
+        cs = [c for n, c in sorted(cards.items()) if n.startswith(prefix)]
+        if not cs:
+            continue
+        entry: dict = {
+            "programs": [c.program for c in cs],
+            "executions": execs,
+            "span_s": round(secs, 4),
+        }
+        if len(cs) == 1:
+            entry["flops_per_dispatch"] = cs[0].flops
+            if "persistent" in cs[0].program:
+                # the card counts ONE while_loop body: executions for
+                # the MFU must be bodies run (loop_iterations), never
+                # ring drains
+                entry["executions"] = m.counters["loop_iterations"]
+                entry["note"] = (
+                    "while-loop program: XLA counts one loop body; "
+                    "executions = loop_iterations, and "
+                    "flops_per_dispatch understates a multi-iteration "
+                    "dispatch"
+                )
+            entry["mfu"] = span_mfu(
+                cs[0],
+                executions=entry["executions"],
+                seconds=secs,
+                peak_flops=peak,
+            )
+        spans[span] = entry
+    record["roofline"] = spans
+
+
 def _dump_obs(record: dict, engine, tag: str) -> None:
     """Per-phase observability artifacts (opt-in via
     ``TDX_SERVE_TRACE_DIR``): a Perfetto trace of the phase — tracer
@@ -400,6 +467,9 @@ def _dump_obs(record: dict, engine, tag: str) -> None:
     }
     registry = obs.MetricsRegistry()
     registry.register_collector(engine.metrics.collector())
+    # the cost observatory's third export: the same cards the record
+    # embeds, as tdx_cost_*{program=...} gauges on the exposition
+    registry.register_collector(engine.cost_book.collector())
     prom_path = os.path.join(out_dir, f"{tag}_metrics.prom")
     with open(prom_path, "w") as f:
         f.write(registry.render())
@@ -504,6 +574,7 @@ def _child(args) -> None:
         wall = time.perf_counter() - t0
 
         record["metrics"] = engine.metrics.to_json()
+        _embed_cost(record, engine)
         # compiles DURING the measured window: nonzero means the warm-up
         # missed a program and the timings above include XLA compiles
         record["recompile_measure"] = watcher.snapshot()
@@ -628,6 +699,7 @@ def _child_prefix(args) -> None:
         # the warm pass's full metrics double as the phase metrics for
         # the shared summary schema
         record["metrics"] = warm_m
+        _embed_cost(record, engine)
         _dump_obs(record, engine, "prefix_share")
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"

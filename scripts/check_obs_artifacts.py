@@ -30,6 +30,12 @@ Usage:
   python scripts/check_obs_artifacts.py --ledger LEDGER.jsonl
     (tdx-ledger-v1 schema validation: every line must parse and every
     row must validate — the perf-sentinel half of the nightly gate)
+  python scripts/check_obs_artifacts.py --cost BENCH_SERVE_CPU.json
+    (cost-card schema validation: every non-error serve phase must
+    embed a non-empty ``cost_cards`` object of valid tdx-cost-v1
+    cards — numeric flops/bytes, peak source NAMED — and a bench.py
+    record's ``extra.train_cost_card`` is checked the same way; the
+    cost-observatory half of the nightly gate)
 """
 
 from __future__ import annotations
@@ -175,12 +181,62 @@ def _check_ledger_main(paths: list) -> None:
     print(f"ledger OK ({len(paths)} file(s))")
 
 
+def _check_cost_main(paths: list) -> None:
+    from torchdistx_tpu.obs.cost import validate_cost_card
+
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    checked = 0
+    for path in paths:
+        n_file = 0
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable record: {e}")
+            continue
+        for name, phase in (record.get("phases") or {}).items():
+            if not isinstance(phase, dict) or "error" in phase:
+                continue
+            cards = phase.get("cost_cards")
+            if not isinstance(cards, dict) or not cards:
+                errors.append(
+                    f"{path}: phase {name} embeds no cost_cards — was the "
+                    "engine built with cost_cards=False (or "
+                    "TDX_COST_CARDS=0)?"
+                )
+                continue
+            for prog, card in cards.items():
+                errors.extend(
+                    validate_cost_card(card, f"{path}:{name}:{prog}")
+                )
+                n_file += 1
+        # bench.py records: the train phase's card lives in extra
+        card = (record.get("extra") or {}).get("train_cost_card")
+        if isinstance(card, dict) and "error" not in card:
+            errors.extend(validate_cost_card(card, f"{path}:train"))
+            n_file += 1
+        checked += n_file
+        print(f"cost {path}: {n_file} card(s)")
+    if checked == 0:
+        errors.append("no cost cards found in any record")
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"cost cards OK ({checked} card(s), {len(paths)} file(s))")
+
+
 def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--flight":
         _check_flight_main(sys.argv[2:])
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--ledger":
         _check_ledger_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--cost":
+        _check_cost_main(sys.argv[2:])
         return
     if len(sys.argv) != 2:
         raise SystemExit(__doc__)

@@ -626,11 +626,22 @@ class TestCLIs:
             doc = json.load(f)
         assert gate_mod.validate_expectations(doc) == []
         fps = set(doc["counters"])
-        assert len(fps) == 3  # k1 + k4 + persistent
+        phase_fps = {fp for fp in fps if "program=" not in fp}
+        cost_fps = fps - phase_fps
+        assert len(phase_fps) == 3  # k1 + k4 + persistent
         for fp in fps:
             assert "max_new_tokens=8" in fp and "requests=6" in fp
             assert "model=tiny" in fp and "num_slots=2" in fp
-        assert any("phase=persistent" in fp for fp in fps)
+        assert any("phase=persistent" in fp for fp in phase_fps)
+        # cost observatory (ISSUE 8): each phase additionally pins its
+        # programs' XLA HLO-analysis counts under program-tagged
+        # fingerprints — and ONLY those (buffer-assignment sizes stay
+        # out of the pins per gate.DEFAULT_COUNTER_EXCLUDE)
+        assert cost_fps and all("program=serve/" in fp for fp in cost_fps)
+        for fp in cost_fps:
+            assert set(doc["counters"][fp]) <= {
+                "cost_flops", "cost_bytes_accessed", "cost_transcendentals"
+            }
 
 
 class TestRecordStamp:

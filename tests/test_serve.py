@@ -941,3 +941,73 @@ class TestMetricsUnit:
         assert parsed["slot_occupancy_mean"] == 0.75
         # decode throughput excludes prefill-sampled tokens
         assert parsed["decode_tokens_per_sec"] == 14.0
+
+
+class TestHBMBudgetGate:
+    """The capacity planner's second admission gate (ISSUE 8): an
+    engine whose projected peak (weights + KV + per-program temps)
+    exceeds ``hbm_budget`` refuses admission with the NAMED reason
+    ``hbm_budget`` in the request's lifecycle events plus the
+    ``admissions_rejected_hbm`` counter — and admits once the budget is
+    raised.  The paged variant pins that the page gate ALONE would have
+    admitted (pages were free; only the budget refused)."""
+
+    def test_slab_engine_refuses_then_admits(self):
+        engine = ServeEngine(_llama(), num_slots=2, max_len=64, hbm_budget=1)
+        h = engine.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+        for _ in range(3):
+            engine.step()
+        assert not h.done()
+        assert engine.scheduler.queue_depth == 1
+        assert engine.metrics.counters["admissions_rejected_hbm"] == 3
+        gated = [e for e in h._request.events if e[0] == "gated"]
+        assert gated and gated[-1][2]["why"] == "hbm_budget"
+        # the gate is live: raising the budget re-admits on the next tick
+        engine.hbm_budget = 10**15
+        while engine.step():
+            pass
+        assert h.done() and h.result().finish_reason == "length"
+        # reason + counter survive into the terminal result's event log
+        assert any(
+            e[0] == "gated" and (e[2] or {}).get("why") == "hbm_budget"
+            for e in h.result().events
+        )
+
+    def test_paged_engine_page_gate_alone_would_admit(self):
+        engine = ServeEngine(
+            _llama(), num_slots=2, max_len=64, page_size=16, hbm_budget=1
+        )
+        prompt = np.arange(1, 9, dtype=np.int32)
+        need = -(-(prompt.size + 4) // engine.page_size)
+        assert engine.pool.free_count >= need  # pages were no obstacle
+        h = engine.submit(prompt, max_new_tokens=4)
+        engine.step()
+        assert not h.done()
+        assert engine.metrics.counters["admissions_rejected_hbm"] == 1
+        # the budget refusal fired BEFORE the page gate: nothing was
+        # reserved, so a later admit starts from a clean reservation
+        assert engine.pool.in_use == 0
+        assert h._request.pages is None
+        engine.hbm_budget = None  # disable the gate entirely
+        while engine.step():
+            pass
+        assert h.done() and h.result().finish_reason == "length"
+
+    def test_budget_with_headroom_admits_immediately(self):
+        engine = ServeEngine(
+            _llama(), num_slots=2, max_len=64, hbm_budget=10**15
+        )
+        r = engine.run(
+            [{"prompt": np.arange(1, 9, dtype=np.int32),
+              "max_new_tokens": 3}]
+        )[0]
+        assert r.finish_reason == "length"
+        assert engine.metrics.counters["admissions_rejected_hbm"] == 0
+
+    def test_memory_plan_schema(self):
+        engine = ServeEngine(_llama(), num_slots=2, max_len=64)
+        plan = engine.memory_plan(budget_bytes=10**12)
+        assert plan["schema"] == "tdx-capacity-v1"
+        assert plan["components"]["kv_cache"] == engine.cache.nbytes
+        assert plan["components"]["weights"] > 0
+        assert plan["fits"] is True and plan["headroom_bytes"] > 0

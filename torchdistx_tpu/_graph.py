@@ -711,6 +711,26 @@ class RecordingSession:
 
             entry = jax.jit(chunk_fn)
             self._chunk_cache[sig] = entry
+            # cost observatory (obs.cost): card each distinct chunk
+            # program — OPT-IN via TDX_COST_CARDS because a card costs
+            # one extra XLA compile and chunked replay's whole value is
+            # its compile/dispatch economics (an always-on probe would
+            # double exactly what bench.py measures)
+            from .obs.cost import cards_enabled
+
+            if cards_enabled():
+                try:
+                    from .obs.cost import compute_cost_card, default_book
+
+                    compute_cost_card(
+                        entry,
+                        ext_vals,
+                        dyn_vals,
+                        name=f"replay/chunk/{self.chunk_compiles}",
+                        book=default_book(),
+                    )
+                except Exception:
+                    pass  # a cost probe must never fail a replay
 
         # one span + recompile-attribution scope per chunk dispatch: a
         # replay whose chunk cache stops hitting shows up as compiles

@@ -41,9 +41,29 @@ PR 7 adds the *perf sentinel* — the layer that reads the evidence back
   gate: exact compare for counters, direction-aware tolerance bands
   for timings (``scripts/perf_gate.py`` is the CI entry point;
   ``scripts/perf_report.py`` renders trends and A/B deltas).
+
+PR 8 adds the *device cost observatory* — what the compiler actually
+built (docs/observability.md "Cost observatory & capacity planner"):
+
+- :mod:`~torchdistx_tpu.obs.cost` — per-program **CostCards** (XLA
+  cost/memory analysis behind ``utils.compat`` shims) with roofline/
+  MFU attribution, exported to Prometheus + Perfetto + ledger counter
+  rows.
+- :func:`~torchdistx_tpu.obs.memory.capacity_plan` — the live HBM
+  budget report (weights + optimizer + KV + per-program temps) the
+  serve engine consults as a second admission gate.
+- :mod:`~torchdistx_tpu.obs.watchdog` — dispatch-stall deadline timer
+  that dumps the flight recorder naming the in-flight program and its
+  cost card (the wedged-relay black box).
 """
 
 from .comm import CommProfile, comm_audit, record_collective
+from .cost import (
+    CostBook,
+    CostCard,
+    compute_cost_card,
+    validate_cost_card,
+)
 from .flight import FlightRecorder, get_flight_recorder
 from .gate import (
     build_expectations,
@@ -61,7 +81,13 @@ from .ledger import (
     validate_ledger_file,
     validate_ledger_row,
 )
-from .memory import hbm_watermark, memory_report, sharding_report
+from .memory import (
+    capacity_plan,
+    device_hbm_budget,
+    hbm_watermark,
+    memory_report,
+    sharding_report,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -74,6 +100,7 @@ from .metrics import (
     start_metrics_server,
 )
 from .recompile import RecompileWatcher, recompile_scope, track_jit_cache
+from .watchdog import DispatchWatchdog
 from .trace import (
     Tracer,
     disable_tracing,
@@ -120,4 +147,11 @@ __all__ = [
     "sharding_report",
     "hbm_watermark",
     "memory_report",
+    "capacity_plan",
+    "device_hbm_budget",
+    "CostBook",
+    "CostCard",
+    "compute_cost_card",
+    "validate_cost_card",
+    "DispatchWatchdog",
 ]

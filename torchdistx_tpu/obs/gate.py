@@ -52,9 +52,19 @@ DEFAULT_TIMING_TOLERANCE = 0.25
 
 #: counters excluded from machine-written expectations: deterministic
 #: per environment but not across jax versions/machines (warm-up compile
-#: counts depend on the jit cache internals of the installed jax)
+#: counts depend on the jit cache internals of the installed jax; the
+#: cost cards' buffer-assignment sizes — arg/out/temp/peak — depend on
+#: the installed XLA's layout and allocator choices, unlike the
+#: HLO-analysis flop/byte counts, which stay pinned)
 DEFAULT_COUNTER_EXCLUDE = frozenset(
-    {"recompile_warmup_compiles", "compiled_programs"}
+    {
+        "recompile_warmup_compiles",
+        "compiled_programs",
+        "cost_arg_bytes",
+        "cost_out_bytes",
+        "cost_temp_bytes",
+        "cost_peak_bytes",
+    }
 )
 
 #: suffix/name patterns whose timing metrics are better when HIGHER;

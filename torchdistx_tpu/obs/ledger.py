@@ -390,6 +390,66 @@ def ingest_serve_record(record: dict, **kw) -> List[dict]:
             "prefill_calls_warm",
         ):
             row(k, phase.get(k), "counter")
+        # cost observatory (obs.cost): one counter row per deterministic
+        # card field per program — XLA flop/byte counts are exact on a
+        # fixed platform, so the gate pins them like host_syncs.  The
+        # card's own counter_fields() already excluded anything
+        # load-dependent (watermark-sourced peaks).
+        rows.extend(
+            _cost_card_rows(
+                phase.get("cost_cards"), workload, platform, quality,
+                meta, source="bench_serve",
+            )
+        )
+    return rows
+
+
+def _cost_card_rows(
+    cards, workload: dict, platform, quality: str, meta: dict, *, source: str
+) -> List[dict]:
+    """Ledger rows for one record's embedded ``cost_cards`` object
+    (``{program: CostCard.to_json()}``): each deterministic ``cost_*``
+    field becomes a counter row whose workload gains the program name
+    (a distinct fingerprint per program, so pins never collide across
+    programs of one phase)."""
+    rows: List[dict] = []
+    if not isinstance(cards, dict):
+        return rows
+    for program, card in sorted(cards.items()):
+        if not isinstance(card, dict):
+            continue
+        cw = dict(workload, program=program)
+        fields = {
+            f"cost_{k}": card.get(k)
+            for k in (
+                "flops",
+                "bytes_accessed",
+                "transcendentals",
+                "arg_bytes",
+                "out_bytes",
+                "temp_bytes",
+            )
+        }
+        if card.get("peak_source") in ("xla_peak", "arg+out+temp"):
+            fields["cost_peak_bytes"] = card.get("peak_bytes")
+        for metric, v in fields.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, float) and not math.isfinite(v):
+                continue
+            rows.append(
+                make_row(
+                    source=source,
+                    metric=metric,
+                    value=v,
+                    metric_class="counter",
+                    quality=quality,
+                    workload=cw,
+                    platform=platform,
+                    unit="bytes" if metric.endswith("_bytes") else None,
+                    **meta,
+                )
+            )
     return rows
 
 
@@ -481,6 +541,23 @@ def ingest_bench_record(record: dict, **kw) -> List[dict]:
         by_scope = rec.get("by_scope") or {}
         window = (by_scope.get("timed_window") or {}).get("compiles")
         row("train_window_compiles", window, "counter", train)
+    # cost observatory: the train step program's card (exact compiler
+    # counts) + the per-span roofline/MFU attribution numbers
+    card = extra.get("train_cost_card")
+    if isinstance(card, dict):
+        rows.extend(
+            _cost_card_rows(
+                {"train/step": card}, train, platform, quality, meta,
+                source="bench",
+            )
+        )
+        row(
+            "train_flop_attribution",
+            card.get("flop_attribution"),
+            "counter",
+            train,
+        )
+    row("mfu_xla", extra.get("mfu_xla"), "timing", train)
     # always at least one row, so even an all-null wedged-relay record
     # leaves a (degraded) mark in the trajectory
     row("bench_complete", int(complete), "counter", {"phase": "driver"})

@@ -20,6 +20,13 @@ after materialization — this module is that check, machine-readable so
   ``utils.profiling.device_memory_stats``, degrading to the host
   ``ru_maxrss`` watermark on backends without PJRT memory stats (the
   CPU test mesh) — the source is always named, never guessed.
+- :func:`capacity_plan` — the LIVE half (ISSUE 8): roll named
+  components (weights, optimizer state, KV pool, per-program temp/peak
+  from the cost observatory's cards) into a per-device budget report
+  with headroom.  ``ServeEngine`` consults it as a second admission
+  gate, and ``sharding_report(budget_bytes_per_device=...)`` extends
+  the audit to per-shard budgets — ROADMAP item 1's
+  "admission/scheduling aware of per-shard HBM budgets" prerequisite.
 """
 
 from __future__ import annotations
@@ -34,8 +41,25 @@ __all__ = [
     "sharding_report",
     "hbm_watermark",
     "memory_report",
+    "capacity_plan",
+    "device_hbm_budget",
+    "tree_device_bytes",
     "last_materialize_report",
 ]
+
+
+def tree_device_bytes(tree: Any) -> int:
+    """Per-device bytes of a params pytree: the largest addressable
+    shard of each array leaf, summed — the weights component of a
+    :func:`capacity_plan` (``ServeEngine.memory_plan`` uses this; the
+    same accounting :func:`sharding_report` applies per entry)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += _device_bytes(leaf, _entry_bytes(leaf))
+    return total
 
 
 def _spec_str(arr: Any) -> str:
@@ -87,6 +111,7 @@ def sharding_report(
     intended_rule: Optional[Callable[[str, Any], Any]] = None,
     optimizer_state: Any = None,
     min_shard_elems: int = 1024,
+    budget_bytes_per_device: Optional[int] = None,
 ) -> dict:
     """Post-materialization sharding audit.
 
@@ -97,6 +122,14 @@ def sharding_report(
     param-shaped slots that are replicated while their parameter is
     sharded.  Returns a JSON-able report; ``report["flags"]`` is the
     actionable list (empty = the memory plan holds).
+
+    ``budget_bytes_per_device`` extends the audit to PER-SHARD HBM
+    budgets (ROADMAP item 1): the report gains a ``shard_budget``
+    section — per-device bytes (params + buffers + optimizer state)
+    against the budget, with headroom — and an ``over_budget`` flag
+    when the per-device footprint exceeds it.  The dryrun TP leg
+    asserts this section flag-free before any TP-serve work trusts the
+    plan.
     """
     import jax
 
@@ -177,6 +210,8 @@ def sharding_report(
         entries.append(entry)
 
     opt_entries = 0
+    opt_bytes = 0
+    opt_device_bytes = 0
     if optimizer_state is not None:
         shape_by_path = {
             p: tuple(a.shape) for p, a in by_sharded_path.items()
@@ -185,6 +220,9 @@ def sharding_report(
             if not isinstance(leaf, jax.Array):
                 continue
             opt_entries += 1
+            leaf_bytes = _entry_bytes(leaf)
+            opt_bytes += leaf_bytes
+            opt_device_bytes += _device_bytes(leaf, leaf_bytes)
             # match the slot to its parameter by path suffix + shape: optax
             # state paths look like "[0].mu['fc1.weight']" around the
             # param's own key
@@ -214,13 +252,15 @@ def sharding_report(
                     }
                 )
 
-    return {
+    report = {
         "schema": "tdx-sharding-v1",
         "n_devices": n_devices,
         "n_entries": len(entries),
         "n_optimizer_entries": opt_entries,
         "total_bytes": total_bytes,
         "bytes_per_device": device_bytes,
+        "optimizer_bytes": opt_bytes,
+        "optimizer_bytes_per_device": opt_device_bytes,
         "replication_factor": round(
             device_bytes * n_devices / total_bytes, 3
         )
@@ -229,6 +269,29 @@ def sharding_report(
         "entries": entries,
         "flags": flags,
     }
+    if budget_bytes_per_device is not None:
+        # the per-shard budget: everything this report accounted that
+        # must co-reside on one device (params/buffers + optimizer
+        # state, largest shard each)
+        shard_total = device_bytes + opt_device_bytes
+        budget = int(budget_bytes_per_device)
+        report["shard_budget"] = {
+            "budget_bytes": budget,
+            "bytes_per_device": shard_total,
+            "headroom_bytes": budget - shard_total,
+            "utilization": round(shard_total / budget, 4) if budget else None,
+        }
+        if shard_total > budget:
+            flags.append(
+                {
+                    "kind": "over_budget",
+                    "path": None,
+                    "bytes": shard_total,
+                    "detail": f"per-device footprint {shard_total} exceeds "
+                    f"the per-shard HBM budget {budget}",
+                }
+            )
+    return report
 
 
 def hbm_watermark() -> dict:
@@ -286,6 +349,62 @@ def memory_report(
         if not include_entries:
             rep = {k: v for k, v in rep.items() if k != "entries"}
         out["sharding"] = rep
+    return out
+
+
+def device_hbm_budget() -> Optional[int]:
+    """This device's real HBM capacity (PJRT ``bytes_limit``, min over
+    devices), or None where the backend reports none (the CPU mesh) —
+    the honest default budget for :func:`capacity_plan` consumers that
+    were not given an explicit one."""
+    from ..utils.profiling import device_memory_stats
+
+    limits = [
+        s["bytes_limit"]
+        for s in device_memory_stats().values()
+        if isinstance(s.get("bytes_limit"), int) and s["bytes_limit"] > 0
+    ]
+    return min(limits) if limits else None
+
+
+def capacity_plan(
+    components: dict,
+    *,
+    budget_bytes: Optional[int] = None,
+) -> dict:
+    """The live HBM capacity planner (``tdx-capacity-v1``): roll named
+    per-device byte components — weights, optimizer state, KV pool,
+    per-program temp/peak from the cost observatory's cards — into one
+    budget report.  ``projected_peak_bytes`` is the sum (the components
+    must co-reside: the KV slab and the weights are both live while a
+    dispatch's temps peak).  With a budget (explicit, or falling back
+    to :func:`device_hbm_budget`) the report carries headroom and a
+    ``fits`` verdict — what ``ServeEngine``'s admission gate refuses
+    on.  Budget-less hosts (the CPU mesh with no explicit budget)
+    report ``fits: None``: unknown, never "yes"."""
+    comps = {
+        k: int(v)
+        for k, v in (components or {}).items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+    total = sum(comps.values())
+    if budget_bytes is None:
+        budget_bytes = device_hbm_budget()
+        budget_source = "pjrt_bytes_limit" if budget_bytes else None
+    else:
+        budget_bytes = int(budget_bytes)
+        budget_source = "explicit"
+    out: dict = {
+        "schema": "tdx-capacity-v1",
+        "components": comps,
+        "projected_peak_bytes": total,
+        "budget_bytes": budget_bytes,
+        "budget_source": budget_source,
+        "headroom_bytes": (
+            None if budget_bytes is None else budget_bytes - total
+        ),
+        "fits": None if budget_bytes is None else total <= budget_bytes,
+    }
     return out
 
 

@@ -80,6 +80,7 @@ contiguous (``page_size=None``) engine (tests/test_serve.py).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from typing import Any, Iterable, List, Optional, Sequence, Union
@@ -89,6 +90,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.cost import CostBook, force_disabled as _cost_force_disabled
 from ..obs.trace import get_tracer, request_trace_events
 
 from ..generation import (
@@ -221,6 +223,28 @@ class ServeEngine:
         production engine with big prompts may want this small — 0
         disables retention entirely (lifecycle events still accumulate
         on in-flight requests and ride out on ``RequestResult.events``).
+      cost_cards: capture a :class:`~torchdistx_tpu.obs.cost.CostCard`
+        (XLA cost/memory analysis) for every compiled program at its
+        first dispatch, queryable from ``engine.cost_book`` and embedded
+        in bench records.  Default True (the engine's program set is
+        bounded — one card per prefill bucket family / decode K /
+        persistent ring); costs one extra XLA compile per program,
+        amortized into warm-up.  ``TDX_COST_CARDS=0`` force-disables.
+      hbm_budget: per-device HBM budget in BYTES for the second
+        admission gate: before admitting, the engine projects its peak
+        footprint (weights + KV cache + the worst per-program temp
+        bytes on record — ``memory_plan()``) and refuses admission when
+        it exceeds the budget, recording ``("gated", why="hbm_budget")``
+        in the request's lifecycle events and bumping the
+        ``admissions_rejected_hbm`` counter.  Mutable at runtime
+        (raise it and the next ``step()`` re-evaluates); None (default)
+        disables the gate — page/token gates alone decide, as before.
+      stall_timeout_s: arm a dispatch-stall watchdog
+        (:class:`~torchdistx_tpu.obs.watchdog.DispatchWatchdog`) around
+        every device dispatch + host sync: a region that overruns this
+        many seconds (the wedged-relay signature) dumps the flight
+        recorder naming the in-flight program and its cost card.  None
+        (default) disables.
     """
 
     def __init__(
@@ -243,6 +267,9 @@ class ServeEngine:
         prefix_cache: bool = True,
         params: Optional[dict] = None,
         finished_history: int = 1024,
+        cost_cards: bool = True,
+        hbm_budget: Optional[int] = None,
+        stall_timeout_s: Optional[float] = None,
     ):
         _check_sampling_args(top_k, top_p)
         cfg = getattr(model, "cfg", None)
@@ -374,6 +401,26 @@ class ServeEngine:
         # event list and the timestamps the aggregate histograms used.
         # maxlen=0 (finished_history=0) retains nothing.
         self._finished: deque = deque(maxlen=int(finished_history))
+        # cost observatory: one CostCard per compiled program, captured
+        # at first dispatch (obs.cost).  Engine-owned book — two engines
+        # on one model never collide
+        self.cost_book = CostBook()
+        self._cards_on = bool(cost_cards) and not _cost_force_disabled()
+        self._carded: set = set()
+        # live HBM capacity gate (obs.memory.capacity_plan); mutable.
+        # the static plan components (weights, kv) are computed once on
+        # first use — the gate re-reads only the cost book's temps
+        self.hbm_budget = hbm_budget
+        self._static_footprint: Optional[dict] = None
+        self._gate = self._make_admission_gate()
+        # dispatch-stall watchdog (obs.watchdog)
+        self.watchdog = None
+        if stall_timeout_s is not None:
+            from ..obs.watchdog import DispatchWatchdog
+
+            self.watchdog = DispatchWatchdog(
+                stall_timeout_s, book=self.cost_book
+            )
 
     # -- public API ------------------------------------------------------
 
@@ -453,9 +500,12 @@ class ServeEngine:
         for req in list(self.scheduler.running):
             if req.expired(now):
                 self._finish(req, "deadline", now)
-        for req, slot in self.scheduler.admit(
-            now, gate=self._page_gate if self.paged else None
-        ):
+        gate = (
+            self._gate
+            if (self.paged or self.hbm_budget is not None)
+            else None
+        )
+        for req, slot in self.scheduler.admit(now, gate=gate):
             self._prefill_request(req, slot)
         if self.scheduler.running:
             self._decode_step()
@@ -764,6 +814,88 @@ class ServeEngine:
             f"({self.prefill_buckets[-1]})"
         )
 
+    def _make_admission_gate(self):
+        """The composed admission predicate ``Scheduler.admit`` runs on
+        the FCFS head: the HBM-budget gate FIRST (a request the device
+        cannot hold must not grab pages), then the paged engine's
+        free-pages gate.  The closure names its refusal cause via the
+        ``why`` attribute the scheduler reads into the request's
+        lifecycle log — the ISSUE 8 named-reason contract."""
+
+        def gate(req: Request) -> bool:
+            gate.why = "gate"
+            if self.hbm_budget is not None:
+                plan = self.memory_plan()
+                if plan["fits"] is False:
+                    gate.why = "hbm_budget"
+                    self.metrics.count("admissions_rejected_hbm")
+                    return False
+            if self.paged:
+                return self._page_gate(req)
+            return True
+
+        gate.why = "gate"
+        return gate
+
+    def memory_plan(self, budget_bytes: Optional[int] = None) -> dict:
+        """The live HBM capacity plan (``obs.memory.capacity_plan``):
+        per-device weights + the KV slab/pools + the worst per-program
+        temp bytes the cost observatory has on record, against
+        ``budget_bytes`` / ``self.hbm_budget`` / the device's PJRT
+        limit (in that order).  This is what the admission gate refuses
+        on; bench_serve embeds it per phase.  With cost cards disabled
+        the temp component is 0 — the plan then under-counts dispatch
+        transients and says so via the component being absent.
+
+        The weights/KV components are invariant after construction and
+        cached: the admission gate runs this per queued-head tick, and
+        a per-tick walk of a 7B param tree would put model-size-scaled
+        host work on the serve hot path."""
+        from ..obs import memory as obs_memory
+
+        if self._static_footprint is None:
+            self._static_footprint = {
+                "weights": obs_memory.tree_device_bytes(self.params),
+                "kv_cache": self.cache.nbytes,
+            }
+        components = dict(self._static_footprint)
+        temp = self.cost_book.max_temp_bytes()
+        if temp:
+            components["program_temp"] = temp
+        if budget_bytes is None:
+            budget_bytes = self.hbm_budget
+        return obs_memory.capacity_plan(
+            components, budget_bytes=budget_bytes
+        )
+
+    # -- cost observatory / stall watchdog --------------------------------
+
+    def _ensure_card(self, name: str, program, args) -> None:
+        """Capture ``program``'s CostCard at its first dispatch (the
+        args are still host-live — lowering reads avals only, so the
+        donated KV slab is safe).  One card per program name; the
+        donated-carry second executable (CLAUDE.md) is the same HLO
+        with different layouts and is deliberately not re-carded.  A
+        cost probe must never fail a dispatch."""
+        if not self._cards_on or name in self._carded:
+            return
+        self._carded.add(name)
+        try:
+            from ..obs.cost import compute_cost_card
+
+            compute_cost_card(
+                program, *args, name=name, book=self.cost_book
+            )
+        except Exception:
+            pass
+
+    def _watch(self, name: str):
+        """The stall-watchdog guard for one dispatch+sync region (a
+        no-op context when no watchdog is configured)."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.arm(name)
+
     def _page_gate(self, req: Request) -> bool:
         """Paged admission gate (run by ``Scheduler.admit`` on the FCFS
         head): match the prompt against the prefix index, reserve the
@@ -851,16 +983,21 @@ class ServeEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : req.prompt.size] = req.prompt
         program = self._prefill_program(bucket)
-        with timed_annotation("serve/prefill", self.metrics.prefill_s.record):
-            kv, tok = program(
-                self.params,
-                self.cache.kv,
-                jnp.asarray(padded),
-                jnp.int32(req.prompt.size),
-                jnp.int32(slot),
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.seed], jnp.int32),
-            )
+        name = f"serve/prefill/b{bucket}"
+        args = (
+            self.params,
+            self.cache.kv,
+            jnp.asarray(padded),
+            jnp.int32(req.prompt.size),
+            jnp.int32(slot),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.seed], jnp.int32),
+        )
+        self._ensure_card(name, program, args)
+        with timed_annotation(
+            "serve/prefill", self.metrics.prefill_s.record
+        ), self._watch(name):
+            kv, tok = program(*args)
             # rebind BEFORE the host sync: the dispatch donated the old
             # slab, so if the sync raises (wedged relay) the engine must
             # already hold the live output, not a deleted buffer
@@ -898,7 +1035,13 @@ class ServeEngine:
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.seed], jnp.int32),
         ]
-        with timed_annotation("serve/prefill", self.metrics.prefill_s.record):
+        name = "serve/prefill/{}/b{}".format(
+            "warm" if pfx > 0 else "cold", bucket
+        )
+        self._ensure_card(name, program, tuple(args))
+        with timed_annotation(
+            "serve/prefill", self.metrics.prefill_s.record
+        ), self._watch(name):
             kv, tok = program(*args)
             self.cache.kv = kv  # before the sync: the pools were donated
             if not self._persistent:  # persistent defers to the drain
@@ -945,9 +1088,11 @@ class ServeEngine:
             # tiny int32 dynamic input; rewritten host-side at every
             # admit/retire, scan-invariant within the chunk
             args.append(jnp.asarray(self.cache.page_tables))
+        name = f"serve/decode/k{k_steps}"
+        self._ensure_card(name, program, tuple(args))
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
-        ) as timing:
+        ) as timing, self._watch(name):
             kv, block = program(*args)
             self.cache.kv = kv  # before the sync: old slab was donated
             block = np.asarray(block)  # ONE host sync per K slot-steps
@@ -1029,9 +1174,11 @@ class ServeEngine:
             # in-loop write can land on a page this table doesn't own
             args.append(jnp.asarray(self.cache.page_tables))
         self._stream_events.clear()
+        name = f"serve/decode/persistent/r{self.ring_capacity}"
+        self._ensure_card(name, program, tuple(args))
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
-        ) as timing:
+        ) as timing, self._watch(name):
             kv, ring, valid, iters = program(*args)
             self.cache.kv = kv  # before the sync: old slab was donated
             # ONE host sync drains the ring, the valid mask, the cursor,

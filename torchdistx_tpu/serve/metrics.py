@@ -104,7 +104,11 @@ class ServeMetrics:
     ``prefix_lookup_tokens`` / ``prefix_hit_tokens`` (prompt tokens
     looked up in the radix index vs served from it; their ratio is the
     derived ``prefix_hit_rate``) and ``pages_evicted`` (LRU evictions
-    from the prefix index under pool pressure).
+    from the prefix index under pool pressure) — and
+    ``admissions_rejected_hbm`` (admission ticks the HBM capacity
+    planner refused because the projected peak exceeded
+    ``ServeEngine(hbm_budget=...)``; the page gate alone would have
+    admitted).
     Gauges: ``queue_depth``, ``active_slots``; paged engines add
     ``pages_in_use`` / ``pages_in_use_hwm`` (current and high-water
     allocated pages) and ``num_pages``; persistent engines add
@@ -172,6 +176,7 @@ class ServeMetrics:
             "prefix_lookup_tokens": 0,
             "prefix_hit_tokens": 0,
             "pages_evicted": 0,
+            "admissions_rejected_hbm": 0,
         }
         self.queue_depth = 0
         self.active_slots = 0

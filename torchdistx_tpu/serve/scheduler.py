@@ -230,7 +230,12 @@ class Scheduler:
                 self._record_gated(head, now, "token_budget")
                 break  # budget holds until running requests retire
             if gate is not None and not gate(head):
-                self._record_gated(head, now, "gate")
+                # a composed gate names WHICH check refused by setting
+                # its own ``why`` attribute before returning False (the
+                # engine's HBM-budget gate says "hbm_budget", the page
+                # gate stays the default) — the named reason the
+                # request's lifecycle log carries
+                self._record_gated(head, now, getattr(gate, "why", "gate"))
                 break  # e.g. pages free up only when running requests end
             self._queue.popleft()
             slot = self._free_slots.pop()

@@ -54,6 +54,8 @@ class Trainer:
         flight: Optional[Any] = None,
         flops_per_token: Optional[float] = None,
         peak_flops: Optional[float] = None,
+        cost_card: bool = True,
+        stall_timeout_s: Optional[float] = None,
     ) -> None:
         self.step = step
         self.params = params
@@ -113,8 +115,31 @@ class Trainer:
             "steps_per_sec": None,
             "tokens_per_sec": None,
             "mfu": None,
+            "mfu_xla": None,
+            "flop_attribution": None,
             "goodput": None,
         }
+        # cost observatory (obs.cost): the step program's CostCard,
+        # captured once at the warmup boundary (one extra compile,
+        # booked as compile overhead).  mfu_xla then reports per-window
+        # MFU from XLA-COUNTED step FLOPs alongside the analytic `mfu`,
+        # and flop_attribution is their ratio (the cost-model
+        # validation check) — per-span numbers, not one end-of-run one.
+        from .obs.cost import force_disabled as _cost_force_disabled
+
+        self._want_cost_card = bool(cost_card) and not _cost_force_disabled()
+        self.cost_card = None
+        # dispatch-stall watchdog (obs.watchdog): armed around every
+        # step dispatch and log-boundary device sync — a wedged step
+        # dumps the flight ring naming "trainer/step" + its cost card
+        self.watchdog = None
+        if stall_timeout_s is not None:
+            from .obs.cost import default_book
+            from .obs.watchdog import DispatchWatchdog
+
+            self.watchdog = DispatchWatchdog(
+                stall_timeout_s, flight=self.flight, book=default_book()
+            )
 
     # -- checkpoint --------------------------------------------------------
 
@@ -210,18 +235,70 @@ class Trainer:
 
         return int(_state.counter)
 
+    def _watch(self, name: str):
+        """Stall-watchdog guard for one device-blocking region (no-op
+        context without a watchdog)."""
+        import contextlib
+
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.arm(name)
+
+    def _capture_cost_card(self, batch) -> None:
+        """Capture the step program's CostCard at the warmup boundary
+        (obs.cost: the one lower/compile/cost_analysis dance, booked as
+        compile overhead by the caller).  Best-effort: a step that
+        cannot be re-lowered (exotic callables) just leaves
+        ``cost_card`` None — the probe must never fail training."""
+        if not self._want_cost_card or self.cost_card is not None:
+            return
+        self._want_cost_card = False  # one attempt, success or not
+        try:
+            import warnings
+
+            from .obs.cost import compute_cost_card, default_book
+
+            analytic = (
+                self.flops_per_token * self.tokens_per_batch
+                if self.flops_per_token and self.tokens_per_batch
+                else None
+            )
+            with warnings.catch_warnings():
+                # a step wrapper's inner jit may carry donate_argnums,
+                # which the outer lowering jit ignores with a warning
+                warnings.simplefilter("ignore")
+                self.cost_card = compute_cost_card(
+                    self.step,
+                    self.params,
+                    self.opt_state,
+                    batch,
+                    name="trainer/step",
+                    analytic_flops=analytic,
+                    book=default_book(),
+                )
+        except Exception:
+            self.cost_card = None
+
     def _update_derived_metrics(self) -> None:
         """goodput / tokens-per-sec / mfu gauges from the accumulated
         wall-time split; cheap, host-only."""
         sps = self.metrics["steps_per_sec"]
+        peak = self.peak_flops
+        if peak is None:
+            from .utils.benchmarks import V5E_PEAK_BF16 as peak
         if sps and self.tokens_per_batch:
             tps = sps * self.tokens_per_batch
             self.metrics["tokens_per_sec"] = tps
             if self.flops_per_token:
-                peak = self.peak_flops
-                if peak is None:
-                    from .utils.benchmarks import V5E_PEAK_BF16 as peak
                 self.metrics["mfu"] = tps * self.flops_per_token / peak
+        card = self.cost_card
+        if card is not None and card.flops and sps:
+            # the XLA-counted sibling of `mfu`: per-window measured
+            # throughput against what the compiler actually built, not
+            # the paper formula — and their ratio as the cost-model
+            # attribution check (obs.cost.CostCard.flop_attribution)
+            self.metrics["mfu_xla"] = sps * card.flops / peak
+            self.metrics["flop_attribution"] = card.flop_attribution
         overhead = (
             self._t_compile + self._t_checkpoint + self._t_rollback
         )
@@ -259,7 +336,7 @@ class Trainer:
             # self.comm_profile ends up holding the per-step comm plan
             with get_tracer().span(
                 "trainer/step", cat="trainer", step=self.global_step
-            ), comm_audit(self.comm_profile):
+            ), comm_audit(self.comm_profile), self._watch("trainer/step"):
                 self.params, self.opt_state, loss = self.step(
                     self.params, self.opt_state, batch
                 )
@@ -272,7 +349,11 @@ class Trainer:
             if warmup_pending:
                 # exclude the first step's jit compile from throughput
                 # windows: wait for it, then restart the clock
-                jax.block_until_ready(loss)
+                with self._watch("trainer/warmup_sync"):
+                    jax.block_until_ready(loss)
+                # the cost observatory's card (one extra compile) rides
+                # the same warmup boundary, booked as compile overhead
+                self._capture_cost_card(batch)
                 self._t_compile += time.time() - t_warm0
                 self.flight.record(
                     "warmup",
@@ -288,7 +369,8 @@ class Trainer:
             # window_steps == 0 right after the warmup reset (log_every=1):
             # skip that boundary instead of logging 0.0 steps/sec
             if self.global_step % self.log_every == 0 and window_steps > 0:
-                jax.block_until_ready(loss)
+                with self._watch("trainer/step_sync"):
+                    jax.block_until_ready(loss)
                 dt = time.time() - t_window
                 last_loss = float(loss)
                 if self.failure_detector is not None:
@@ -454,6 +536,8 @@ class Trainer:
                 "steps_per_sec",
                 "tokens_per_sec",
                 "mfu",
+                "mfu_xla",
+                "flop_attribution",
                 "goodput",
             ):
                 if m[name] is not None:

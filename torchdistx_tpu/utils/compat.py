@@ -15,7 +15,16 @@ here: the private jit ``_cache_size`` introspection
 persistent decode loop adds the host-callback pair
 (:func:`get_io_callback` / :func:`get_debug_callback`) — availability
 probes returning None on drifted jax, with the engine falling back to
-its pure ring-drain path when both are absent.
+its pure ring-drain path when both are absent.  The cost observatory
+(``obs.cost``) adds the compiled-executable introspection pair
+(:func:`compiled_cost_analysis` / :func:`compiled_memory_analysis`):
+``Compiled.cost_analysis()`` has already flipped between returning a
+list-of-dicts and a bare dict across jax versions, and
+``memory_analysis()`` returns a ``CompiledMemoryStats`` whose
+attribute set drifts (this container's 0.4.37 has
+``argument/output/temp/alias_size_in_bytes`` but NO peak field —
+newer jaxlibs add ``peak_memory_in_bytes``), so both are normalized
+to plain dicts here and the peak's SOURCE is always named.
 
 Lives under ``utils`` so leaf consumers (``ops.attention``, the model
 forwards) can use ``axis_size`` without importing the parallel package —
@@ -43,6 +52,8 @@ __all__ = [
     "register_compile_listener",
     "get_io_callback",
     "get_debug_callback",
+    "compiled_cost_analysis",
+    "compiled_memory_analysis",
 ]
 
 
@@ -108,6 +119,79 @@ def get_debug_callback():
     except ImportError:
         return None
     return getattr(debug, "callback", None)
+
+
+def compiled_cost_analysis(compiled):
+    """XLA cost analysis of a ``Compiled`` executable as one plain dict
+    (``{"flops": ..., "bytes accessed": ...}``), or None when this jax
+    offers no cost analysis.  Normalizes the cross-version return drift:
+    0.4.x returns a one-element list of dicts (one per partition), newer
+    jax a bare dict, and some backends None."""
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ca = fn()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if isinstance(ca, dict) else None
+
+
+#: CompiledMemoryStats attribute -> normalized dict key.  Only the
+#: device-side sizes; host_* duplicates are deliberately dropped.
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "arg_bytes"),
+    ("output_size_in_bytes", "out_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def compiled_memory_analysis(compiled):
+    """Buffer-assignment sizes of a ``Compiled`` executable as a plain
+    dict (``arg_bytes``/``out_bytes``/``temp_bytes``/``alias_bytes``/
+    ``generated_code_bytes`` + ``peak_bytes`` with its source NAMED), or
+    None when this jax has no ``memory_analysis``.
+
+    ``peak_source`` says where ``peak_bytes`` came from: ``"xla_peak"``
+    (a jaxlib exposing ``peak_memory_in_bytes``) or
+    ``"arg+out+temp"`` (this container's 0.4.37, which reports the
+    components but no peak — the sum is the executable's worst-case
+    live footprint with no overlap credit, an upper bound).  Callers
+    that fall further back (e.g. to ``obs.memory.hbm_watermark``) must
+    keep naming the source — a peak whose provenance is unknown is how
+    HBM-overcommit postmortems go wrong."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ma = fn()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, key in _MEMORY_FIELDS:
+        v = getattr(ma, attr, None)
+        if isinstance(v, int):
+            out[key] = v
+    if not out:
+        return None
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if isinstance(peak, int) and peak > 0:
+        out["peak_bytes"] = peak
+        out["peak_source"] = "xla_peak"
+    else:
+        out["peak_bytes"] = (
+            out.get("arg_bytes", 0)
+            + out.get("out_bytes", 0)
+            + out.get("temp_bytes", 0)
+        )
+        out["peak_source"] = "arg+out+temp"
+    return out
 
 
 def register_compile_listener(cb) -> bool:

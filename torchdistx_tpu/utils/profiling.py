@@ -84,18 +84,26 @@ def cost_summary(fn: Any, *args: Any, peak_flops: Optional[float] = None, **kwar
     ...}`` plus, with ``peak_flops`` (e.g. 197e12 for v5e bf16), a
     ``compute_bound_s`` roofline floor; for the memory side divide
     ``bytes_accessed`` by your HBM bandwidth.
+
+    Since the cost observatory landed this is a PROJECTION of a
+    :class:`~torchdistx_tpu.obs.cost.CostCard` (the single
+    implementation of the lower/compile/cost_analysis dance lives in
+    ``obs.cost.compute_cost_card``); the record schema
+    ``scripts/profile_train_step.py`` emits is unchanged.
     """
-    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
-    flops = float(ca.get("flops", 0.0))
-    byts = float(ca.get("bytes accessed", 0.0))
+    from ..obs.cost import compute_cost_card
+
+    card = compute_cost_card(fn, *args, name="cost_summary", **kwargs)
+    flops = card.flops or 0.0
+    byts = card.bytes_accessed or 0.0
     out = {
         "flops": flops,
         "bytes_accessed": byts,
+        # the pre-refactor contract: 0.0 (not None) for a 0-FLOP
+        # program with traffic; None only when bytes are zero
         "arithmetic_intensity": flops / byts if byts else None,
-        "output_bytes": float(ca.get("bytes accessed output", 0.0)),
-        "transcendentals": float(ca.get("transcendentals", 0.0)),
+        "output_bytes": card.output_bytes_accessed or 0.0,
+        "transcendentals": card.transcendentals or 0.0,
     }
     if peak_flops:
         out["compute_bound_s"] = flops / peak_flops
