@@ -117,6 +117,33 @@ def _parse_args():
         default=16,
         help="KV page size (tokens) for the --prefix-share phase",
     )
+    ap.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor-parallel degree: every phase runs its engine on a "
+        "('tp',) mesh of this many devices (params Megatron-sharded, KV "
+        "head-sharded) and embeds the phase's comm-audit bytes; on the "
+        "CPU smoke the parent raises the child's virtual device count "
+        "to match",
+    )
+    ap.add_argument(
+        "--chunked-prefill",
+        type=int,
+        default=None,
+        metavar="T",
+        help="append a chunked-prefill A/B phase: a long-prompt admission "
+        "mid-decode, unchunked vs chunked at threshold T (must be a "
+        "prefill bucket) — the headline is the active requests' max "
+        "inter-token gap, chunked strictly below unchunked",
+    )
+    ap.add_argument(
+        "--artifact",
+        default=None,
+        help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
+        "(the nightly 2-device-mesh leg writes its own file so the "
+        "single-chip artifact is never clobbered)",
+    )
     return ap.parse_args()
 
 
@@ -171,6 +198,19 @@ def _phase_summary(rec: dict) -> dict:
             tokens_prefilled_warm=rec.get("tokens_prefilled_warm"),
             pages_in_use_hwm=rec.get("pages_in_use_hwm"),
         )
+    if "max_gap_s_chunked" in rec:  # the chunked-prefill A/B phase
+        out.update(
+            max_gap_s_unchunked=rec.get("max_gap_s_unchunked"),
+            max_gap_s_chunked=rec.get("max_gap_s_chunked"),
+            gap_reduction=rec.get("gap_reduction"),
+            interleaved_dispatches=rec.get("interleaved_dispatches"),
+        )
+    if (rec.get("mesh") or 1) > 1:
+        # the tdx-comm-v1 profile embedded by the TP phases
+        comm = rec.get("comm") or {}
+        out["comm_wire_bytes"] = sum(
+            (comm.get("bytes_by_axis") or {}).values()
+        )
     return out
 
 
@@ -199,6 +239,7 @@ def _supervise(args) -> None:
         "deadline_s": deadline,
         "decode_chunks": chunks,
         "decode_modes": modes,
+        "mesh": args.tp,
         "phases": {},
     }
     # phase plan: K=1 baseline, the chunk A/B, the persistent loop
@@ -214,6 +255,16 @@ def _supervise(args) -> None:
                 {
                     "TDX_SERVE_CHUNK": str(chunks[-1]),
                     "TDX_SERVE_PHASE": "prefix_share",
+                },
+            )
+        )
+    if args.chunked_prefill is not None:
+        plan.append(
+            (
+                "chunked_prefill",
+                {
+                    "TDX_SERVE_CHUNK": str(chunks[-1]),
+                    "TDX_SERVE_PHASE": "chunked_prefill",
                 },
             )
         )
@@ -253,6 +304,13 @@ def _supervise(args) -> None:
             continue
         cmd = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
         env = dict(os.environ, TDX_SERVE_CHILD="1", **phase_env)
+        if args.tp > 1 and env.get("TDX_BENCH_PLATFORM") == "cpu":
+            # the CPU smoke needs enough virtual devices for the mesh;
+            # the flag must be set before the child imports jax
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.tp}"
+            ).strip()
         phase: dict = {}
         try:
             proc = subprocess.run(
@@ -282,7 +340,7 @@ def _supervise(args) -> None:
         record["phases"][name] = phase
         emit()  # full record after EVERY phase — the consumer contract
 
-    _write_artifact(record)
+    _write_artifact(record, args.artifact)
     # perf-sentinel hook: normalize this run into LEDGER.jsonl rows so
     # the trajectory (and the nightly gate's baselines) grow with every
     # run — never raises, disabled by TDX_LEDGER=0
@@ -301,24 +359,30 @@ def _supervise(args) -> None:
         sys.exit(1)
 
 
-def _write_artifact(record: dict) -> None:
-    """Persist the record as BENCH_SERVE_<CPU|TPU>.json — but never let a
+def _write_artifact(record: dict, artifact: str = None) -> None:
+    """Persist the record as BENCH_SERVE_<CPU|TPU>.json (or the --artifact
+    override) — but never let a
     run that produced no phase evidence misfile or clobber real evidence
     (the KERNEL_ACCEPT guard convention): the platform comes from what
     the phases actually REPORTED, falling back to the requested platform,
     and an all-error record never replaces an existing error-free one."""
     phases = record["phases"].values()
-    reported = {p.get("platform") for p in phases if p.get("platform")}
-    if reported:
-        plat = "CPU" if "cpu" in reported else "TPU"
-    elif os.environ.get("TDX_BENCH_PLATFORM"):
-        plat = "CPU" if os.environ["TDX_BENCH_PLATFORM"] == "cpu" else "TPU"
+    if artifact:
+        out_path = os.path.abspath(artifact)
     else:
-        return  # nothing reported where it ran: print-only, no file
-    out_path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        f"BENCH_SERVE_{plat}.json",
-    )
+        reported = {p.get("platform") for p in phases if p.get("platform")}
+        if reported:
+            plat = "CPU" if "cpu" in reported else "TPU"
+        elif os.environ.get("TDX_BENCH_PLATFORM"):
+            plat = (
+                "CPU" if os.environ["TDX_BENCH_PLATFORM"] == "cpu" else "TPU"
+            )
+        else:
+            return  # nothing reported where it ran: print-only, no file
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            f"BENCH_SERVE_{plat}.json",
+        )
     all_error = all("error" in p for p in phases) or not record["phases"]
     if all_error and os.path.exists(out_path):
         try:
@@ -371,9 +435,31 @@ def _phase_setup(args, **extra) -> tuple:
         "num_slots": args.slots,
         "decode_chunk": k_chunk,
         "decode_mode": mode,
+        # ALWAYS emitted (1 when single-chip): a ledger workload key, so
+        # TP-mesh counter rows can never collide with single-chip pins
+        "mesh": args.tp,
         **extra,
     }
     return record, name, k_chunk, plat
+
+
+def _mesh_kwargs(args) -> dict:
+    """``ServeEngine(mesh=...)`` kwargs for the requested TP degree
+    (empty when --tp 1: the single-chip engine path stays the
+    reference)."""
+    if args.tp <= 1:
+        return {}
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < args.tp:
+        raise RuntimeError(
+            f"--tp {args.tp} needs {args.tp} devices, found {len(devs)}"
+        )
+    return {"mesh": Mesh(np.asarray(devs[: args.tp]), ("tp",))}
 
 
 def _embed_cost(record: dict, engine) -> None:
@@ -516,6 +602,7 @@ def _child(args) -> None:
             num_slots=args.slots,
             max_len=max_len,
             **engine_kw,
+            **_mesh_kwargs(args),
         )
         if persistent:
             record["ring_capacity"] = engine.ring_capacity
@@ -559,20 +646,26 @@ def _child(args) -> None:
         record["recompile_warmup"] = watcher.snapshot()
         watcher.reset()  # the measured window must compile NOTHING
 
+        from torchdistx_tpu.obs.comm import comm_audit
+
         t0 = time.perf_counter()
-        results = engine.run(
-            [
-                {
-                    "prompt": p,
-                    "max_new_tokens": args.max_new,
-                    "temperature": args.temperature,
-                    "seed": i,
-                }
-                for i, p in enumerate(prompts)
-            ]
-        )
+        with comm_audit() as comm_prof:
+            results = engine.run(
+                [
+                    {
+                        "prompt": p,
+                        "max_new_tokens": args.max_new,
+                        "temperature": args.temperature,
+                        "seed": i,
+                    }
+                    for i, p in enumerate(prompts)
+                ]
+            )
         wall = time.perf_counter() - t0
 
+        # per-phase collective traffic (tdx-comm-v1): the engine's
+        # closed-form TP all-reduce accounting — empty at --tp 1
+        record["comm"] = comm_prof.to_json()
         record["metrics"] = engine.metrics.to_json()
         _embed_cost(record, engine)
         # compiles DURING the measured window: nonzero means the warm-up
@@ -625,6 +718,7 @@ def _child_prefix(args) -> None:
             max_len=max_len,
             decode_chunk=k_chunk,
             page_size=ps,
+            **_mesh_kwargs(args),
         )
         # the production shape: every request opens with the same long
         # system prompt, tails differ
@@ -673,9 +767,14 @@ def _child_prefix(args) -> None:
         record["recompile_warmup"] = watcher.snapshot()
         watcher.reset()  # both timed passes must compile nothing
 
-        record["cold"] = run_pass()
-        record["warm"] = run_pass()
+        from torchdistx_tpu.obs.comm import comm_audit
+
+        with comm_audit() as comm_prof:
+            record["cold"] = run_pass()
+            record["warm"] = run_pass()
         record["recompile_measure"] = watcher.snapshot()
+        # both passes' analytic collective profile (mesh runs)
+        record["comm"] = comm_prof.to_json()
         cold_m, warm_m = record["cold"]["metrics"], record["warm"]["metrics"]
         record["tokens_prefilled_cold"] = cold_m["counters"][
             "tokens_prefilled"
@@ -706,11 +805,203 @@ def _child_prefix(args) -> None:
     print(json.dumps(record))
 
 
+def _child_chunked_prefill(args) -> None:
+    """The chunked-prefill A/B phase: short requests decoding, then ONE
+    long-prompt admission mid-flight — unchunked (the long prefill is a
+    single dispatch that stalls every active slot) vs chunked at
+    threshold T (the engine interleaves a decode dispatch between
+    chunks).  The headline is the short requests' max inter-token gap
+    across the admission window, computed from the ``decode_chunk``
+    lifecycle events (one host timestamp per dispatch walk); the phase
+    flags ``error`` when chunking does not strictly shrink the gap, so
+    the STRICT nightly catches a broken interleave.  Token streams must
+    be bit-identical between the two engines (chunking may never change
+    what a request decodes, only when the host sees it)."""
+    t_chunk = int(args.chunked_prefill)
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="chunked_prefill", chunked_prefill=t_chunk
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu import obs
+    from torchdistx_tpu.serve import ServeEngine
+
+    watcher = obs.RecompileWatcher()
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        if t_chunk >= max_len:
+            raise ValueError(
+                f"--chunked-prefill {t_chunk} must be < max_len {max_len}"
+            )
+        # one bucket per side of the threshold: long prompts pad to
+        # max_len (the stall being A/B'd), chunks dispatch through the
+        # T-bucket program
+        buckets = (t_chunk, max_len)
+        # geometry: the shorts must still be DECODING through the whole
+        # admission window — two settled chunks before the admission
+        # (1 + 2K tokens) plus one chunk per interleave — while the long
+        # request only needs its first token, so it gets the minimum
+        # budget and the longest admissible prompt
+        short_len = max(1, t_chunk // 2)
+        short_new = min(
+            max_len - short_len,
+            max(args.max_new, 4 * k_chunk + 4),
+        )
+        long_new = 2
+        long_len = max_len - long_new
+        if long_len <= t_chunk:
+            raise ValueError(
+                f"max_len {max_len} leaves no long prompt above the "
+                f"chunk threshold {t_chunk}"
+            )
+        n_short = max(1, min(args.slots - 1, 4))
+        rs = np.random.RandomState(0)
+        shorts = [
+            rs.randint(0, 256, (short_len,)).astype(np.int32)
+            for _ in range(n_short)
+        ]
+        long_prompt = rs.randint(0, 256, (long_len,)).astype(np.int32)
+
+        def scenario(engine):
+            """Shorts first, two settled decode chunks, then the long
+            admission; returns (short_results, long_result)."""
+            hs = [
+                engine.submit(
+                    p,
+                    max_new_tokens=short_new,
+                    temperature=args.temperature,
+                    seed=100 + i,
+                )
+                for i, p in enumerate(shorts)
+            ]
+            engine.step()
+            engine.step()
+            t_submit = time.monotonic()
+            hl = engine.submit(
+                long_prompt,
+                max_new_tokens=long_new,
+                temperature=args.temperature,
+                seed=7,
+            )
+            while engine.step():
+                pass
+            return [h.result() for h in hs], hl.result(), t_submit
+
+        def max_gap(short_results, long_result, t_submit):
+            """Largest inter-token wall gap of any short request whose
+            gap interval overlaps the long request's admission window
+            (submit .. first token) — the stall being measured."""
+            t_first = next(
+                (ts for nm, ts, _ in long_result.events
+                 if nm == "first_token"),
+                None,
+            )
+            if t_first is None:
+                raise RuntimeError("long request never emitted a token")
+            worst = 0.0
+            for r in short_results:
+                times = [
+                    ts
+                    for nm, ts, _ in r.events
+                    if nm in ("first_token", "decode_chunk")
+                ]
+                for a, b in zip(times, times[1:]):
+                    if b >= t_submit and a <= t_first:
+                        worst = max(worst, b - a)
+            return worst
+
+        def run_side(chunked: bool):
+            engine = ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=buckets,
+                chunked_prefill=t_chunk if chunked else None,
+                **_mesh_kwargs(args),
+            )
+            # warm both prefill buckets (+ the chunked warm-prefill
+            # program) and the decode program past the donated-carry
+            # second-call recompile: the full scenario, twice
+            scenario(engine)
+            scenario(engine)
+            # min over repeats: the structural stall (the long prefill
+            # blocking the decode walk) is a FLOOR on the max gap —
+            # host noise (GC, scheduler) only ever adds, so the min is
+            # the robust estimator and keeps the strict A/B from
+            # flaking on tiny CPU-smoke intervals.  Metrics and the
+            # comm profile are reset per repeat so the embedded
+            # (deterministic, gated) counters cover exactly ONE
+            # scenario.
+            gap = None
+            for _ in range(3):
+                engine.reset_metrics()
+                watcher.reset()
+                with comm_audit() as comm_prof:
+                    s, l, t_submit = scenario(engine)
+                g = max_gap(s, l, t_submit)
+                gap = g if gap is None else min(gap, g)
+            return engine, gap, s, l, comm_prof
+
+        from torchdistx_tpu.obs.comm import comm_audit
+
+        eng_a, gap_a, shorts_a, long_a, _ = run_side(chunked=False)
+        eng_b, gap_b, shorts_b, long_b, comm_b = run_side(chunked=True)
+        record["recompile_measure"] = watcher.snapshot()
+        # the chunked side's analytic collective profile (mesh runs)
+        record["comm"] = comm_b.to_json()
+
+        record["max_gap_s_unchunked"] = round(gap_a, 6)
+        record["max_gap_s_chunked"] = round(gap_b, 6)
+        record["gap_reduction"] = round(gap_a / gap_b, 3) if gap_b else None
+        mb = eng_b.metrics.to_json()
+        record["interleaved_dispatches"] = mb["counters"].get(
+            "prefill_interleaved_dispatches", 0
+        )
+        record["prefill_chunks"] = mb["counters"].get("prefill_chunks", 0)
+        streams_equal = all(
+            np.array_equal(ra.tokens, rb.tokens)
+            for ra, rb in zip(shorts_a, shorts_b)
+        ) and np.array_equal(long_a.tokens, long_b.tokens)
+        record["streams_identical"] = streams_equal
+        record["max_len"] = max_len
+        record["long_prompt_tokens"] = int(long_len)
+        # the chunked engine's metrics double as the phase metrics
+        record["metrics"] = mb
+        _embed_cost(record, eng_b)
+        if not streams_equal:
+            record["error"] = (
+                "chunked prefill changed a token stream — interleaving "
+                "must be latency-only"
+            )
+        elif record["interleaved_dispatches"] < 1:
+            record["error"] = (
+                "chunked prefill never interleaved a decode dispatch "
+                f"(long prompt {long_len} tokens, threshold {t_chunk})"
+            )
+        elif not gap_b < gap_a:
+            record["error"] = (
+                "chunked prefill did not shrink the admission stall "
+                f"(max inter-token gap {gap_b:.4f}s chunked vs "
+                f"{gap_a:.4f}s unchunked)"
+            )
+        _dump_obs(record, eng_b, "chunked_prefill")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def main() -> None:
     args = _parse_args()
     if os.environ.get("TDX_SERVE_CHILD") == "1":
-        if os.environ.get("TDX_SERVE_PHASE") == "prefix_share":
+        phase = os.environ.get("TDX_SERVE_PHASE")
+        if phase == "prefix_share":
             _child_prefix(args)
+        elif phase == "chunked_prefill":
+            _child_chunked_prefill(args)
         else:
             _child(args)
     else:

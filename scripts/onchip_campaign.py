@@ -76,13 +76,21 @@ def _steps() -> list:
         # not exist yet (ROADMAP standing constraint) — the first
         # healthy-relay window must land it.  Default phases: K=1
         # baseline, the fused K sweep, the persistent whole-loop A/B,
-        # and the shared-prefix cold/warm pass; bench_serve's own
-        # deadline sits UNDER the step budget so its graceful final
-        # record emit never races the subprocess kill.
+        # the shared-prefix cold/warm pass, and the chunked-prefill
+        # long-admission A/B (ISSUE 10); bench_serve's own deadline sits
+        # UNDER the step budget so its graceful final record emit never
+        # races the subprocess kill.  TP degree: the CPU smoke runs the
+        # 2-device mesh leg (virtual devices); the real machine has ONE
+        # v5e chip, so the on-chip record runs --tp 1 through the SAME
+        # mesh engine path (sharded programs, degenerate mesh) — the
+        # multi-chip numbers come from the dryrun driver's CPU-mesh leg
+        # until more chips exist.
         ("serve_engine_ab",
          [py, os.path.join(sdir, "bench_serve.py"), "--prefix-share"]
-         + (["--decode-chunk", "4", "--requests", "6", "--max-new", "8",
-             "--slots", "2"] if smoke else []),
+         + (["--tp", "2", "--chunked-prefill", "16", "--decode-chunk",
+             "4", "--requests", "6", "--max-new", "8", "--slots", "2",
+             "--max-len", "64"] if smoke
+            else ["--tp", "1", "--chunked-prefill", "256"]),
          {} if smoke else {"TDX_BENCH_DEADLINE": "800"}, 900),
         ("flash_long_context",
          [py, os.path.join(sdir, "bench_flash_attention.py")]

@@ -1011,3 +1011,251 @@ class TestHBMBudgetGate:
         assert plan["components"]["kv_cache"] == engine.cache.nbytes
         assert plan["components"]["weights"] > 0
         assert plan["fits"] is True and plan["headroom_bytes"] > 0
+
+
+def _llama_tp():
+    # default n_kv_heads (= n_heads = 4): divisible by every tp in the
+    # grid.  (_llama's n_kv_heads=2 is the divisibility-ERROR case.)
+    tdx.manual_seed(0)
+    return Llama.from_name("tiny", max_seq_len=64)
+
+
+def _tp_mesh(tp):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+
+
+def _serve_vs_generate(model, engine, prompts, max_new=6):
+    """Drive the engine and pin every greedy stream bit-identical to
+    the single-device ``generation.generate`` reference."""
+    results = engine.run(
+        [{"prompt": p, "max_new_tokens": max_new} for p in prompts]
+    )
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length" and not r.truncated
+        ref = np.asarray(generate(model, jnp.asarray(p[None]), max_new))[0]
+        np.testing.assert_array_equal(
+            np.concatenate([p, r.tokens]), ref
+        )
+
+
+class TestTPServing:
+    """Mesh-parallel serving: params Megatron-sharded (llama_tp_rule),
+    KV slabs/pools sharded over the head axis, page tables host-side —
+    and every greedy stream still bit-identical to the single-device
+    reference (CPU mesh: column-parallel matmuls are exact per element
+    and the tiny head-sharded reductions do not reorder a greedy
+    argmax).  Fast siblings here; the full tp x K x mode x layout grid
+    is the -m slow sweep below."""
+
+    def test_tp2_slab_fused_matches_single_device(self):
+        model = _llama_tp()
+        engine = ServeEngine(
+            model, num_slots=3, max_len=64, prefill_buckets=(16,),
+            decode_chunk=4, mesh=_tp_mesh(2),
+        )
+        assert engine.tp == 2
+        _serve_vs_generate(model, engine, _prompts(21, (6, 11, 9, 4, 13)))
+        # the KV cache is genuinely head-sharded: each device addresses
+        # half the slab bytes, and the admission input reports per-shard
+        kv = engine.cache.kv[0][0]
+        shard = kv.sharding.shard_shape(kv.shape)
+        assert shard[2] == kv.shape[2] // 2
+        assert (
+            engine.memory_plan()["components"]["kv_cache"]
+            == engine.cache.nbytes // 2
+        )
+
+    def test_tp2_paged_persistent_matches_single_device(self):
+        model = _llama_tp()
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,),
+            decode_mode="persistent", page_size=16, mesh=_tp_mesh(2),
+        )
+        _serve_vs_generate(model, engine, _prompts(22, (5, 12, 9)))
+
+    def test_tp_mesh_comm_audit_pins_closed_form(self):
+        from torchdistx_tpu.obs.comm import comm_audit
+
+        model = _llama_tp()
+        engine = ServeEngine(
+            model, num_slots=2, max_len=64, prefill_buckets=(16,),
+            decode_chunk=4, mesh=_tp_mesh(2),
+        )
+        with comm_audit() as prof:
+            engine.run(
+                [{"prompt": p, "max_new_tokens": 6}
+                 for p in _prompts(23, (7, 10))]
+            )
+        c = engine.metrics.counters
+        nl, dim = model.cfg.n_layers, model.cfg.dim
+        # 2 all-reduces per block (attention out + MLP down), per
+        # prefill dispatch and per on-device decode step
+        expected_ops = 2 * nl * (c["prefill_calls"] + c["decode_steps"])
+        assert prof.ops("all_reduce", "tp") == expected_ops
+        # payload: n_tokens x dim x 4B per all-reduce — prefills carry
+        # their padded bucket, decode steps carry num_slots rows
+        expected_payload = (
+            2 * nl * 4 * dim
+            * (c["tokens_prefilled"] + c["decode_steps"] * engine.num_slots)
+        )
+        assert prof.payload_bytes("all_reduce", "tp") == expected_payload
+        # ring all-reduce wire ratio 2(n-1)/n = 1.0 at tp=2
+        assert prof.wire_bytes("all_reduce", "tp") == expected_payload
+        # single-device engines record nothing (guards fingerprinted
+        # expectations: the tp=1 rows must stay collective-free)
+        single = ServeEngine(
+            _llama_tp(), num_slots=2, max_len=64, prefill_buckets=(16,)
+        )
+        with comm_audit() as empty:
+            single.run([{"prompt": _prompts(23, (7,))[0],
+                         "max_new_tokens": 4}])
+        assert empty.ops() == 0
+
+    def test_kv_head_divisibility_error(self):
+        # _llama: n_kv_heads=2 — a 4-way tp mesh cannot shard the head
+        # axis; the constructor must say so, not die inside jit
+        with pytest.raises(ValueError, match="does not divide"):
+            ServeEngine(_llama(), num_slots=2, max_len=64,
+                        mesh=_tp_mesh(4))
+
+    def test_mesh_axis_and_rule_validation(self):
+        from jax.sharding import Mesh
+
+        bad = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+        with pytest.raises(ValueError, match="tp_axis"):
+            ServeEngine(_llama_tp(), num_slots=1, max_len=32, mesh=bad)
+        from torchdistx_tpu.parallel.tp import llama_tp_rule
+
+        with pytest.raises(ValueError, match="requires mesh"):
+            ServeEngine(
+                _llama_tp(), num_slots=1, max_len=32,
+                tp_rule=llama_tp_rule(_tp_mesh(2)),
+            )
+
+
+@pytest.mark.slow
+class TestTPServingSlowGrid:
+    """The pinned grid of the issue: tp in {1,2,4} x K in {1,4} x
+    {chunked,persistent} x {slab,paged}, every greedy stream
+    bit-identical to the single-device reference."""
+
+    @pytest.mark.parametrize("tp", [1, 2, 4])
+    @pytest.mark.parametrize("k_chunk", [1, 4])
+    @pytest.mark.parametrize("mode", ["chunked", "persistent"])
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_grid(self, tp, k_chunk, mode, paged):
+        model = _llama_tp()
+        kw = dict(
+            num_slots=2, max_len=64, prefill_buckets=(16,),
+            mesh=_tp_mesh(tp),
+        )
+        if mode == "persistent":
+            if k_chunk != 1:
+                pytest.skip("persistent mode has no decode_chunk")
+            kw["decode_mode"] = "persistent"
+        else:
+            kw["decode_chunk"] = k_chunk
+        if paged:
+            kw["page_size"] = 16
+        engine = ServeEngine(model, **kw)
+        _serve_vs_generate(model, engine, _prompts(31, (6, 13, 9)))
+
+
+class TestChunkedPrefill:
+    """Chunked prefill: a long-prompt admission is split into
+    bucket-sized chunks with a decode dispatch interleaved between
+    them, so active slots keep emitting — and the streams stay
+    bit-identical (interleaving is latency-only)."""
+
+    def _ab(self, *, paged=False, mesh=None):
+        model = _llama_tp()
+        kw = dict(
+            num_slots=3, max_len=64, prefill_buckets=(16, 64),
+            decode_chunk=2,
+        )
+        if paged:
+            kw["page_size"] = 16
+        if mesh is not None:
+            kw["mesh"] = mesh
+        plain = ServeEngine(model, **kw)
+        chunked = ServeEngine(model, **kw, chunked_prefill=16)
+
+        def scenario(engine):
+            shorts = [
+                engine.submit(p, max_new_tokens=20)
+                for p in _prompts(41, (5, 9))
+            ]
+            engine.step()
+            engine.step()
+            long_h = engine.submit(
+                _prompts(42, (40,))[0], max_new_tokens=6
+            )
+            while engine.step():
+                pass
+            return [h.result() for h in shorts], long_h.result()
+
+        return model, plain, chunked, scenario
+
+    def test_decode_slots_emit_between_chunks(self):
+        _, plain, chunked, scenario = self._ab()
+        shorts_a, long_a = scenario(plain)
+        shorts_b, long_b = scenario(chunked)
+        c = chunked.metrics.counters
+        assert c["chunked_prefills"] == 1
+        # 40-token prompt, threshold 16: chunks of 16+16+8 (the tail
+        # rides its own bucket-16 dispatch)
+        assert c["prefill_chunks"] == 3
+        assert c["prefill_interleaved_dispatches"] == 2
+        assert plain.metrics.counters["chunked_prefills"] == 0
+        # the latency claim: short slots received tokens BETWEEN the
+        # long prompt's chunks — decode_chunk events timestamped inside
+        # the admission window (prefill start .. long first token)
+        t0 = next(ts for n, ts, d in long_b.events if n == "prefill")
+        t1 = next(ts for n, ts, d in long_b.events if n == "first_token")
+        interleaved = [
+            ts
+            for r in shorts_b
+            for n, ts, _ in r.events
+            if n == "decode_chunk" and t0 < ts < t1
+        ]
+        assert interleaved, "no decode dispatch landed between chunks"
+        # and chunking changed WHEN, never WHAT: all streams identical
+        for ra, rb in zip(shorts_a + [long_a], shorts_b + [long_b]):
+            np.testing.assert_array_equal(ra.tokens, rb.tokens)
+
+    def test_paged_chunked_prefill_streams_identical(self):
+        _, plain, chunked, scenario = self._ab(paged=True)
+        shorts_a, long_a = scenario(plain)
+        shorts_b, long_b = scenario(chunked)
+        assert chunked.metrics.counters["chunked_prefills"] == 1
+        assert chunked.metrics.counters["prefill_interleaved_dispatches"] > 0
+        for ra, rb in zip(shorts_a + [long_a], shorts_b + [long_b]):
+            np.testing.assert_array_equal(ra.tokens, rb.tokens)
+
+    def test_tp_mesh_chunked_prefill_streams_identical(self):
+        _, plain, chunked, scenario = self._ab(mesh=_tp_mesh(2))
+        shorts_a, long_a = scenario(plain)
+        shorts_b, long_b = scenario(chunked)
+        assert chunked.metrics.counters["prefill_interleaved_dispatches"] > 0
+        for ra, rb in zip(shorts_a + [long_a], shorts_b + [long_b]):
+            np.testing.assert_array_equal(ra.tokens, rb.tokens)
+
+    def test_chunked_prefill_requires_bucket(self):
+        with pytest.raises(ValueError, match="must be one of"):
+            ServeEngine(
+                _llama_tp(), num_slots=1, max_len=64,
+                prefill_buckets=(16, 64), chunked_prefill=12,
+            )
+
+    def test_short_prompts_never_chunk(self):
+        engine = ServeEngine(
+            _llama_tp(), num_slots=1, max_len=64,
+            prefill_buckets=(16, 64), chunked_prefill=16,
+        )
+        r = engine.run(
+            [{"prompt": _prompts(43, (10,))[0], "max_new_tokens": 4}]
+        )[0]
+        assert r.finish_reason == "length"
+        assert engine.metrics.counters["chunked_prefills"] == 0

@@ -329,12 +329,27 @@ def _decode_tokens(
     )
 
 
-def _cached_jit(model, store: str, cache_key, build, donate_argnums=()):
+def _cached_jit(
+    model, store: str, cache_key, build, donate_argnums=(), out_shardings=None
+):
     # jit cache lives ON the model so executables (which close over the
-    # model) are collected with it rather than pinned by a module global
+    # model) are collected with it rather than pinned by a module global.
+    # out_shardings (a pytree prefix) must be passed explicitly for any
+    # output NOT derived from a same-sharded input — jit does not
+    # propagate input shardings into fresh outputs (the mesh serve
+    # programs' sampled tokens/rings; same rule as optimizer state in
+    # parallel/fsdp.optimizer_state_shardings).  Callers relying on it
+    # must bake a mesh identity into cache_key: out_shardings is only
+    # applied at the miss, so two engines sharing a key would silently
+    # share the first engine's shardings.
     builders = model.__dict__.setdefault(store, {})
     if cache_key not in builders:
-        builders[cache_key] = jax.jit(build, donate_argnums=donate_argnums)
+        kwargs = {}
+        if out_shardings is not None:
+            kwargs["out_shardings"] = out_shardings
+        builders[cache_key] = jax.jit(
+            build, donate_argnums=donate_argnums, **kwargs
+        )
     return builders[cache_key]
 
 

@@ -14,6 +14,12 @@ The CI-enforceable consequence of the ledger's counter/timing split
   workload fingerprint — ``degraded`` rows never serve as the
   baseline, and improvements always pass.
 
+Serve fingerprints carry a ``mesh=`` tag (the TP degree; 1 when
+single-chip) so TP-serve counter rows gate against their own pins —
+a 2-device mesh run dispatches the same programs but its fingerprint,
+and therefore its expectations entry, is distinct
+(``expectations/serve_cpu_mesh2.json`` vs ``serve_cpu_smoke.json``).
+
 Expectations file shape (committed, machine-written by
 ``scripts/perf_gate.py --update-expectations``)::
 

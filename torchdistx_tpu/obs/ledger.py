@@ -274,7 +274,10 @@ def validate_ledger_file(path: str) -> List[str]:
 
 #: timing metrics lifted from each serve phase's embedded histograms
 _SERVE_HIST_TIMINGS = ("ttft_s", "e2e_latency_s", "decode_token_s", "tpot_s")
-#: serve-phase fields that define the workload fingerprint
+#: serve-phase fields that define the workload fingerprint.  ``mesh``
+#: (the TP degree, 1 for single-chip) keeps TP-serve counter rows from
+#: colliding with single-chip pins; ``chunked_prefill`` likewise splits
+#: the chunked-prefill A/B phases, whose dispatch counters differ.
 _SERVE_WORKLOAD_KEYS = (
     "model",
     "requests",
@@ -285,6 +288,8 @@ _SERVE_WORKLOAD_KEYS = (
     "ring_capacity",
     "page_size",
     "max_len",
+    "mesh",
+    "chunked_prefill",
 )
 
 

@@ -32,7 +32,7 @@ from .fsdp import (
     strided_split,
 )
 
-__all__ = ["tp_shard_rule", "llama_tp_rule", "GSPMDTrainStep"]
+__all__ = ["tp_shard_rule", "llama_tp_rule", "shard_params", "GSPMDTrainStep"]
 
 
 def tp_shard_rule(
@@ -86,6 +86,30 @@ def llama_tp_rule(
         (r"lm_head\.weight$", P(tp_axis, f)),
     ]
     return tp_shard_rule(mesh, patterns)
+
+
+def shard_params(
+    params: dict, rule: Callable[[str, Any], NamedSharding]
+) -> dict:
+    """Apply a ``tp_shard_rule``-style rule to an already-materialized
+    parameter dict: each leaf is ``device_put`` to ``rule(path, leaf)``
+    unless it already carries an equivalent sharding (a no-op then — the
+    check keeps re-entrant calls from issuing redundant transfers).
+
+    This is the post-hoc sibling of being *born* sharded via
+    ``materialize_module(sharding_rule=...)`` — the serving path uses it
+    because inference engines usually receive finished weights rather
+    than materialize them (``ServeEngine(mesh=, tp_rule=)``).
+    """
+    out = {}
+    for path, leaf in params.items():
+        target = rule(path, leaf)
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and sh.is_equivalent_to(target, leaf.ndim):
+            out[path] = leaf
+        else:
+            out[path] = jax.device_put(leaf, target)
+    return out
 
 
 @dataclasses.dataclass

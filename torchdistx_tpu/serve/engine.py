@@ -90,6 +90,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.comm import record_collective
 from ..obs.cost import CostBook, force_disabled as _cost_force_disabled
 from ..obs.trace import get_tracer, request_trace_events
 
@@ -117,14 +118,52 @@ from .scheduler import Request, RequestHandle, RequestResult, Scheduler
 __all__ = ["ServeEngine"]
 
 
-def _kv_placement(params: dict):
-    """Where the slot cache lives: REPLICATED over the params' mesh when
-    they are sharded (a cache committed to one device against mesh-
-    committed params is an incompatible-devices jit error), the default
-    device otherwise.  Sharding the cache itself is future work
-    (docs/serving.md)."""
+def _cache_sharding(
+    params: dict,
+    mesh=None,
+    tp_axis: str = "tp",
+    kv_heads: Optional[int] = None,
+):
+    """Device placement for the slot/paged KV cache.
+
+    With a ``mesh`` the policy is the **head-axis sharding**: every cache
+    array is ``(num_slots | num_pages, rows, Hkv, D)``, and
+    ``NamedSharding(mesh, P(None, None, tp_axis, None))`` co-locates each
+    device's ``Hkv / tp`` head group with the Megatron column shards
+    (``wq``/``wk``/``wv``) that produce it — attention then partitions
+    along heads under GSPMD with no cache collective at all, and each
+    device holds ``1/tp`` of the KV footprint (which is what
+    ``memory_plan()`` admits against).  ``kv_heads % tp`` is asserted
+    here with a named error: an uneven split would make GSPMD pad or
+    replicate the head axis, silently devouring the HBM the sharding
+    exists to save.
+
+    REPLICATED is the *fallback*, not the policy: with no mesh but
+    sharded params (e.g. FSDP-materialized weights passed via
+    ``params=``), the cache is replicated over the params' mesh (a cache
+    committed to one device against mesh-committed params is an
+    incompatible-devices jit error); with single-device params, the
+    default device.
+    """
     from jax.sharding import NamedSharding, PartitionSpec
 
+    if mesh is not None:
+        tp = int(mesh.shape[tp_axis])
+        if kv_heads is None:
+            raise ValueError(
+                "cannot head-shard the KV cache: the model's config "
+                "exposes no KV head count (n_kv_heads / n_heads / "
+                "n_head) — pass mesh=None to serve it replicated"
+            )
+        if kv_heads % tp != 0:
+            raise ValueError(
+                f"KV cache head axis (n_kv_heads={kv_heads}) does not "
+                f"divide over the '{tp_axis}' mesh axis ({tp} devices): "
+                f"{kv_heads} % {tp} != 0.  Pick a tp degree that divides "
+                "n_kv_heads (or a model with more KV heads) — an uneven "
+                "split would silently replicate the head axis"
+            )
+        return NamedSharding(mesh, PartitionSpec(None, None, tp_axis, None))
     for leaf in jax.tree_util.tree_leaves(params):
         sh = getattr(leaf, "sharding", None)
         if isinstance(sh, NamedSharding):
@@ -245,6 +284,39 @@ class ServeEngine:
         many seconds (the wedged-relay signature) dumps the flight
         recorder naming the in-flight program and its cost card.  None
         (default) disables.
+      mesh: a ``jax.sharding.Mesh`` to serve tensor-parallel over.  The
+        params are sharded with ``tp_rule`` (``parallel.tp.shard_params``
+        — a no-op for leaves already carrying the target sharding), the
+        KV slab/pools are sharded over the HEAD axis
+        (:func:`_cache_sharding` — ``P(None, None, tp_axis, None)``,
+        with ``n_kv_heads % tp`` asserted), page tables stay host-side,
+        and every compiled program becomes one SPMD program with
+        explicit ``out_shardings`` on its donated KV carry and sampled
+        outputs (jit does not propagate input shardings into fresh
+        outputs).  Per-layer all-reduce counts/bytes are recorded
+        analytically into any active ``obs.comm.comm_audit`` — GSPMD
+        collectives are invisible to Python-level tracing, so the engine
+        pins the Megatron closed form (2 per block) at dispatch time,
+        exactly like the training TP leg.  ``memory_plan()`` accounts
+        per-shard bytes, so the HBM admission gate sees the ``1/tp``
+        footprint that makes 7B+ models servable.  None (default): the
+        single-device/replicated engine, unchanged.
+      tp_rule: parameter sharding rule ``(path, leaf) -> NamedSharding``
+        applied when ``mesh`` is given; default
+        ``parallel.tp.llama_tp_rule(mesh, tp_axis)``.
+      tp_axis: the mesh axis name to tensor-shard over (default
+        ``"tp"``); other axes of the mesh are left replicated.
+      chunked_prefill: prefill-chunk threshold in tokens.  A prompt (or
+        paged uncached suffix) LONGER than this is prefilled in chunks
+        of at most this many tokens — each chunk a warm (traced
+        ``cache_pos``) dispatch — with one decode dispatch interleaved
+        between consecutive chunks, so a long prompt no longer stalls
+        every active decode slot for its whole prefill (the tail-latency
+        half of the serving win; the ``tpot_s``/inter-token-gap effect
+        is measured by ``bench_serve.py --chunked-prefill``).  Must be
+        one of ``prefill_buckets`` (each full chunk reuses that bucket's
+        program).  Token streams are unchanged — chunking only
+        reschedules the prefill compute.  None (default) disables.
     """
 
     def __init__(
@@ -270,6 +342,10 @@ class ServeEngine:
         cost_cards: bool = True,
         hbm_budget: Optional[int] = None,
         stall_timeout_s: Optional[float] = None,
+        mesh: Optional[Any] = None,
+        tp_rule: Optional[Any] = None,
+        tp_axis: str = "tp",
+        chunked_prefill: Optional[int] = None,
     ):
         _check_sampling_args(top_k, top_p)
         cfg = getattr(model, "cfg", None)
@@ -290,6 +366,35 @@ class ServeEngine:
         self.model = model
         self.params = (
             params if params is not None else dict(model.named_parameters())
+        )
+        # -- mesh path: TP-shard params + cache, SPMD-compile programs --
+        self.mesh = mesh
+        self.tp_axis = str(tp_axis)
+        if mesh is not None:
+            if self.tp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh has no '{self.tp_axis}' axis (axes: "
+                    f"{tuple(mesh.axis_names)}) — pass tp_axis="
+                )
+            self.tp = int(mesh.shape[self.tp_axis])
+            from ..parallel.tp import llama_tp_rule, shard_params
+
+            if tp_rule is None:
+                tp_rule = llama_tp_rule(mesh, self.tp_axis)
+            self.params = shard_params(self.params, tp_rule)
+        else:
+            if tp_rule is not None:
+                raise ValueError("tp_rule requires mesh=")
+            self.tp = 1
+        self._tp_rule = tp_rule
+        # closed-form comm accounting needs the block geometry; a model
+        # whose config doesn't expose it serves fine, just unaudited
+        _layers = getattr(cfg, "n_layers", None) or getattr(
+            cfg, "n_layer", None
+        )
+        _dim = getattr(cfg, "dim", None) or getattr(cfg, "n_embd", None)
+        self._tp_geom = (
+            (int(_layers), int(_dim)) if _layers and _dim else None
         )
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
@@ -339,6 +444,41 @@ class ServeEngine:
             # appending a max_len bucket used to hide that ceiling AND
             # compile a program the caller never asked for.)
         self.prefill_buckets = buckets
+        if chunked_prefill is not None:
+            chunked_prefill = int(chunked_prefill)
+            if chunked_prefill not in self.prefill_buckets:
+                raise ValueError(
+                    f"chunked_prefill ({chunked_prefill}) must be one of "
+                    f"prefill_buckets {self.prefill_buckets} — every full "
+                    "chunk is dispatched through that bucket's program"
+                )
+        self.chunked_prefill = chunked_prefill
+        # KV cache placement: head-axis sharded on the mesh path,
+        # replicated-over-params'-mesh / default-device otherwise
+        _kv_heads = getattr(cfg, "n_kv_heads", None) or getattr(
+            cfg, "n_heads", None
+        ) or getattr(cfg, "n_head", None)
+        _placement = _cache_sharding(
+            self.params,
+            mesh=mesh,
+            tp_axis=self.tp_axis,
+            kv_heads=None if _kv_heads is None else int(_kv_heads),
+        )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # explicit out_shardings for every compiled program's outputs
+        # (the donated KV carry and the sampled token/ring outputs —
+        # jit does not propagate input shardings into fresh outputs);
+        # None on the single-device path, where committed inputs pin
+        # the outputs already
+        self._kv_sharding = (
+            _placement if isinstance(_placement, NamedSharding) else None
+        )
+        self._repl_sharding = (
+            None
+            if self._kv_sharding is None
+            else NamedSharding(self._kv_sharding.mesh, PartitionSpec())
+        )
         self.page_size = None if page_size is None else int(page_size)
         self.paged = self.page_size is not None
         if self.paged:
@@ -358,7 +498,7 @@ class ServeEngine:
                 self.max_len,
                 self.page_size,
                 self.num_pages,
-                placement=_kv_placement(self.params),
+                placement=_placement,
             )
         else:
             if num_pages is not None:
@@ -370,7 +510,7 @@ class ServeEngine:
                 model,
                 self.num_slots,
                 self.max_len,
-                placement=_kv_placement(self.params),
+                placement=_placement,
             )
         self.scheduler = Scheduler(self.num_slots, max_tokens_in_flight)
         self.metrics = ServeMetrics(
@@ -643,11 +783,36 @@ class ServeEngine:
 
     def _static_key(self) -> tuple:
         # page_size keys the cache LAYOUT: a paged and a slab engine on
-        # the same model must never share (or co-count) programs
+        # the same model must never share (or co-count) programs.  The
+        # mesh fingerprint (axis names/sizes + device ids) keys the SPMD
+        # partitioning: a tp=2 program and a single-chip program on the
+        # same model have different out_shardings baked in and must
+        # never collide in the shared jit store
+        if self.mesh is None:
+            mesh_key = None
+        else:
+            mesh_key = (
+                tuple(
+                    (str(a), int(s)) for a, s in self.mesh.shape.items()
+                ),
+                self.tp_axis,
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
         return (
             self.num_slots, self.max_len, self.top_k, self.top_p,
-            self.page_size,
+            self.page_size, mesh_key,
         )
+
+    def _out_shardings(self, n_scalar: int):
+        """The explicit ``out_shardings`` pytree prefix for one serve
+        program: the (donated) KV carry keeps the cache's head-axis
+        sharding, the ``n_scalar`` sampled outputs (token / ring / valid
+        / cursor) come back replicated.  None when the cache has no
+        NamedSharding placement — single-device programs stay exactly as
+        before."""
+        if self._kv_sharding is None:
+            return None
+        return (self._kv_sharding,) + (self._repl_sharding,) * n_scalar
 
     def _prefill_program(self, bucket: int):
         model, sampler = self.model, self._sampler
@@ -680,6 +845,50 @@ class ServeEngine:
             ("serve_prefill", bucket) + self._static_key(),
             build,
             donate_argnums=(1,),
+            out_shardings=self._out_shardings(1),
+        )
+
+    def _prefill_warm_program(self, bucket: int):
+        """Warm SLAB prefill (chunked prefill's mid-cache chunks): gather
+        the slot's row from the engine cache, run the chunk's tokens
+        against it at a TRACED ``cache_pos`` (the jnp attention band —
+        ``cached_attention``'s flash fast path needs a static 0), sample
+        from the chunk's last real position, and write the whole updated
+        row back.  One program per bucket, shared across chunk positions
+        and slots.  The sampled token only matters for the FINAL chunk
+        (it is the request's first token, sampler step 0 — identical to
+        the unchunked program's); intermediate chunks discard it."""
+        model, sampler, max_len = self.model, self._sampler, self.max_len
+
+        def build(params, kv, tokens, cache_pos, true_len, slot, temp, seed):
+            view = [
+                (
+                    jax.lax.dynamic_slice(
+                        ck, (slot, 0, 0, 0), (1, max_len) + ck.shape[2:]
+                    ),
+                    jax.lax.dynamic_slice(
+                        cv, (slot, 0, 0, 0), (1, max_len) + cv.shape[2:]
+                    ),
+                )
+                for ck, cv in kv
+            ]
+            logits, view = functional_call(
+                model, params, (tokens, view, cache_pos),
+                method="forward_cached",
+            )
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1
+            )[:, 0, :]
+            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+            return write_slot(kv, view, slot), tok[0]
+
+        return _cached_jit(
+            model,
+            "_serve_jit_cache",
+            ("serve_prefill_warm", bucket) + self._static_key(),
+            build,
+            donate_argnums=(1,),
+            out_shardings=self._out_shardings(1),
         )
 
     def _paged_prefill_program(self, bucket: int, warm: bool):
@@ -734,6 +943,7 @@ class ServeEngine:
             ("serve_prefill_paged", bucket, warm) + self._static_key(),
             build_warm if warm else build_cold,
             donate_argnums=(1,),
+            out_shardings=self._out_shardings(1),
         )
 
     def _decode_program(self):
@@ -759,6 +969,7 @@ class ServeEngine:
             + self._static_key(),
             build,
             donate_argnums=(1,),  # kv slab: same aliasing as prefill
+            out_shardings=self._out_shardings(1),
         )
 
     def _persistent_program(self):
@@ -781,7 +992,12 @@ class ServeEngine:
                     ring_capacity=self.ring_capacity,
                     stream_cb=self._stream_cb,
                 )
-                self._stream_program = jax.jit(build, donate_argnums=(1,))
+                kwargs = {}
+                if self._out_shardings(3) is not None:
+                    kwargs["out_shardings"] = self._out_shardings(3)
+                self._stream_program = jax.jit(
+                    build, donate_argnums=(1,), **kwargs
+                )
             return self._stream_program
         build = _make_persistent_decode(
             self.model,
@@ -798,6 +1014,7 @@ class ServeEngine:
             + self._static_key(),
             build,
             donate_argnums=(1,),  # kv slab: same aliasing as prefill
+            out_shardings=self._out_shardings(3),
         )
 
     # -- internals -------------------------------------------------------
@@ -813,6 +1030,42 @@ class ServeEngine:
             f"prompt length {length} exceeds the largest prefill bucket "
             f"({self.prefill_buckets[-1]})"
         )
+
+    def _prefill_chunks(self, start0: int, total: int) -> list:
+        """Split ``total`` prefill tokens starting at cache position
+        ``start0`` into ``(start, length)`` chunks of at most
+        ``chunked_prefill`` tokens.  Every non-final chunk is exactly the
+        threshold (its bucket is the threshold itself — validated to be
+        a real bucket) and always fits: ``start + C <= start0 + total <=
+        max_len``.  The FINAL chunk's padded bucket may overrun
+        ``max_len`` (a short tail bucket-padded past the end would make
+        the write clamp onto real rows); such a tail is folded into its
+        predecessor, terminating — in the worst case — at the one-chunk
+        split, whose bucket fit was already guaranteed at admission."""
+        c = self.chunked_prefill
+        chunks = []
+        s = 0
+        while s < total:
+            ln = min(c, total - s)
+            chunks.append((start0 + s, ln))
+            s += ln
+        while len(chunks) > 1:
+            st, ln = chunks[-1]
+            if st + self._bucket_for(ln) <= self.max_len:
+                break
+            pst, pln = chunks[-2]
+            chunks[-2:] = [(pst, pln + ln)]
+        return chunks
+
+    def _interleave_decode(self, req: Request) -> None:
+        """One decode dispatch between two prefill chunks, skipping the
+        half-prefilled request — the whole point of chunked prefill:
+        active slots emit tokens while the long prompt is still landing.
+        Skipped when this request is the only one running (nothing to
+        un-stall)."""
+        if len(self.scheduler.running) > 1:
+            self.metrics.count("prefill_interleaved_dispatches")
+            self._decode_step(skip=req)
 
     def _make_admission_gate(self):
         """The composed admission predicate ``Scheduler.admit`` runs on
@@ -854,9 +1107,15 @@ class ServeEngine:
         from ..obs import memory as obs_memory
 
         if self._static_footprint is None:
+            # PER-SHARD accounting on both components: tree_device_bytes
+            # is the largest addressable shard per leaf, so TP-sharded
+            # weights and the head-sharded cache each contribute their
+            # 1/tp slice — the number a single device must actually hold,
+            # which is what makes the admission gate meaningful for
+            # models bigger than one chip's HBM
             self._static_footprint = {
                 "weights": obs_memory.tree_device_bytes(self.params),
-                "kv_cache": self.cache.nbytes,
+                "kv_cache": obs_memory.tree_device_bytes(self.cache.kv),
             }
         components = dict(self._static_footprint)
         temp = self.cost_book.max_temp_bytes()
@@ -895,6 +1154,30 @@ class ServeEngine:
         if self.watchdog is None:
             return contextlib.nullcontext()
         return self.watchdog.arm(name)
+
+    def _record_tp_collectives(self, n_tokens: int, steps: int = 1) -> None:
+        """Closed-form per-layer all-reduce accounting for the mesh path,
+        recorded into any active :func:`obs.comm.comm_audit`.  GSPMD
+        inserts the collectives at compile time, invisibly to Python-
+        level tracing (obs/comm.py module doc), so the engine records the
+        Megatron closed form at dispatch time — exactly like the training
+        TP leg's ``allreduce_linear`` pins: one all-reduce of the
+        ``(n_tokens, dim)`` activation per ROW-PARALLEL projection
+        (``wo`` + ``w_down`` = 2 per block), per on-device step.  The
+        lm_head gather and sampler reductions are tiny and deliberately
+        not modeled.  No-op off the mesh path, on tp=1 meshes, and for
+        models whose config hides the block geometry."""
+        if self.tp <= 1 or self._tp_geom is None:
+            return
+        n_layers, dim = self._tp_geom
+        itemsize = 4  # f32 activations (the serve models' param dtype)
+        record_collective(
+            "all_reduce",
+            self.tp_axis,
+            payload_bytes=int(n_tokens) * dim * itemsize,
+            count=2 * n_layers * int(steps),
+            axis_size=self.tp,
+        )
 
     def _page_gate(self, req: Request) -> bool:
         """Paged admission gate (run by ``Scheduler.admit`` on the FCFS
@@ -978,6 +1261,11 @@ class ServeEngine:
         self.metrics.ttft_s.record(req.first_token_at - req.submitted_at)
 
     def _dispatch_prefill_slab(self, req: Request, slot: int) -> int:
+        if (
+            self.chunked_prefill is not None
+            and req.prompt.size > self.chunked_prefill
+        ):
+            return self._dispatch_prefill_slab_chunked(req, slot)
         bucket = self._bucket_for(req.prompt.size)
         req.record_event("prefill", bucket=bucket, cold=True)
         padded = np.zeros((1, bucket), np.int32)
@@ -1005,6 +1293,80 @@ class ServeEngine:
             if not self._persistent:  # persistent defers to the drain
                 tok = int(np.asarray(tok))  # host sync: first token exists
         self.metrics.count("tokens_prefilled", bucket)
+        self._record_tp_collectives(bucket)
+        return tok
+
+    def _dispatch_prefill_slab_chunked(self, req: Request, slot: int) -> int:
+        """Chunked SLAB prefill: the prompt lands in
+        ``chunked_prefill``-sized chunks — the first through the cold
+        (static ``cache_pos=0``) bucket program, the rest through the
+        warm slot-row family (``_prefill_warm_program``) — with one
+        decode dispatch interleaved between consecutive chunks
+        (``_interleave_decode``, skipping this half-prefilled request).
+
+        The slot is PARKED at row ``max_len - 1`` for the duration: the
+        interleaved decode program rewrites every slot's current row,
+        inactive slots included, and the slot's stale position could
+        land that garbage inside an already-written chunk.  Row
+        ``max_len - 1`` is safe: prefill never claims it (``prompt <=
+        max_len - max_new < max_len``), a slab row is private to its
+        slot, and the slot's own decode write replaces it in the same
+        dispatch that first makes it visible (the stale-row argument of
+        kv_cache.py, applied to one designated row).  ``cache.admit``
+        restores the true position after the final chunk."""
+        chunks = self._prefill_chunks(0, req.prompt.size)
+        req.record_event(
+            "prefill",
+            bucket=self._bucket_for(chunks[0][1]),
+            cold=True,
+            chunks=len(chunks),
+        )
+        self.cache.pos[slot] = self.max_len - 1  # park (see docstring)
+        self.metrics.count("chunked_prefills")
+        tok = None
+        for i, (start, ln) in enumerate(chunks):
+            if i > 0:
+                self._interleave_decode(req)
+            bucket = self._bucket_for(ln)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :ln] = req.prompt[start : start + ln]
+            req.record_event("prefill_chunk", start=start, bucket=bucket)
+            if start == 0:
+                program = self._prefill_program(bucket)
+                name = f"serve/prefill/b{bucket}"
+                args = (
+                    self.params,
+                    self.cache.kv,
+                    jnp.asarray(padded),
+                    jnp.int32(ln),
+                    jnp.int32(slot),
+                    jnp.asarray([req.temperature], jnp.float32),
+                    jnp.asarray([req.seed], jnp.int32),
+                )
+            else:
+                program = self._prefill_warm_program(bucket)
+                name = f"serve/prefill/warm/b{bucket}"
+                args = (
+                    self.params,
+                    self.cache.kv,
+                    jnp.asarray(padded),
+                    jnp.int32(start),
+                    jnp.int32(ln),
+                    jnp.int32(slot),
+                    jnp.asarray([req.temperature], jnp.float32),
+                    jnp.asarray([req.seed], jnp.int32),
+                )
+            self._ensure_card(name, program, args)
+            with timed_annotation(
+                "serve/prefill", self.metrics.prefill_s.record
+            ), self._watch(name):
+                kv, tok = program(*args)
+                self.cache.kv = kv  # before any sync: slab was donated
+                if i == len(chunks) - 1 and not self._persistent:
+                    tok = int(np.asarray(tok))  # host sync: first token
+            self.metrics.count("tokens_prefilled", bucket)
+            self.metrics.count("prefill_chunks")
+            self._record_tp_collectives(bucket)
         return tok
 
     def _dispatch_prefill_paged(self, req: Request, slot: int) -> int:
@@ -1012,6 +1374,11 @@ class ServeEngine:
         slot's table at the chain, prefill ONLY the uncached suffix
         (tokens past the page-aligned prefix hit), and adopt the
         request's full-prompt pages into the prefix index."""
+        if (
+            self.chunked_prefill is not None
+            and req.prompt.size - req.prefix_len > self.chunked_prefill
+        ):
+            return self._dispatch_prefill_paged_chunked(req, slot)
         ps, pfx = self.page_size, req.prefix_len
         suffix = req.prompt[pfx:]
         bucket = self._bucket_for(suffix.size)
@@ -1049,16 +1416,92 @@ class ServeEngine:
         # only the suffix bucket was computed — the prefix hit is the
         # prefill compute (and token) the cache saved
         self.metrics.count("tokens_prefilled", bucket)
-        if self.prefix_index is not None:
-            self.metrics.count("prefix_lookup_tokens", int(req.prompt.size))
-            self.metrics.count("prefix_hit_tokens", pfx)
-            n_full = req.prompt.size // ps
-            self.prefix_index.insert(
-                req.prompt[: n_full * ps], req.pages[:n_full], self.pool
-            )
+        self._record_tp_collectives(bucket)
+        self._adopt_prefix(req)
         return tok
 
-    def _decode_step(self) -> None:
+    def _adopt_prefix(self, req: Request) -> None:
+        """Post-prefill prefix bookkeeping shared by the one-shot and
+        chunked paged paths: hit-rate counters + handing the request's
+        full-prompt page-aligned pages to the radix index."""
+        if self.prefix_index is None:
+            return
+        ps = self.page_size
+        self.metrics.count("prefix_lookup_tokens", int(req.prompt.size))
+        self.metrics.count("prefix_hit_tokens", req.prefix_len)
+        n_full = req.prompt.size // ps
+        self.prefix_index.insert(
+            req.prompt[: n_full * ps], req.pages[:n_full], self.pool
+        )
+
+    def _dispatch_prefill_paged_chunked(self, req: Request, slot: int) -> int:
+        """Chunked PAGED prefill: the uncached suffix lands in chunks
+        through the EXISTING cold/warm paged program families — the warm
+        family's traced ``pfx_len`` is exactly a chunk's start position,
+        so chunked prefill and prefix-hit prefill share programs — with
+        decode dispatches interleaved like the slab path.
+
+        Parking at ``max_len - 1`` is safe here too: the parked write
+        routes through the slot's table to its LAST entry — the scratch
+        page for a short chain, else the request's own tail page, never
+        a shared prefix page (the prefix is at most the prompt, which
+        sits strictly below ``max_len``, so the hit can never reach the
+        last table entry) — and the slot's own decode write replaces the
+        row in the dispatch that first makes it visible."""
+        ps, pfx = self.page_size, req.prefix_len
+        suffix = req.prompt[pfx:]
+        chunks = self._prefill_chunks(pfx, suffix.size)
+        req.record_event(
+            "prefill",
+            bucket=self._bucket_for(chunks[0][1]),
+            cold=pfx == 0,
+            prefix_hit_tokens=pfx,
+            chunks=len(chunks),
+        )
+        self.cache.set_table(slot, req.pages)
+        self.cache.pos[slot] = self.max_len - 1  # park (slab docstring)
+        self.metrics.count("chunked_prefills")
+        tok = None
+        for i, (start, ln) in enumerate(chunks):
+            if i > 0:
+                self._interleave_decode(req)
+            bucket = self._bucket_for(ln)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :ln] = req.prompt[start : start + ln]
+            req.record_event("prefill_chunk", start=start, bucket=bucket)
+            warm = start > 0
+            program = self._paged_prefill_program(bucket, warm=warm)
+            args = [
+                self.params,
+                self.cache.kv,
+                jnp.asarray(self.cache.page_tables[slot]),
+                jnp.asarray(padded),
+            ]
+            if warm:
+                args.append(jnp.int32(start))
+            args += [
+                jnp.int32(ln),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.seed], jnp.int32),
+            ]
+            name = "serve/prefill/{}/b{}".format(
+                "warm" if warm else "cold", bucket
+            )
+            self._ensure_card(name, program, tuple(args))
+            with timed_annotation(
+                "serve/prefill", self.metrics.prefill_s.record
+            ), self._watch(name):
+                kv, tok = program(*args)
+                self.cache.kv = kv  # before any sync: pools were donated
+                if i == len(chunks) - 1 and not self._persistent:
+                    tok = int(np.asarray(tok))
+            self.metrics.count("tokens_prefilled", bucket)
+            self.metrics.count("prefill_chunks")
+            self._record_tp_collectives(bucket)
+        self._adopt_prefix(req)
+        return tok
+
+    def _decode_step(self, skip: Optional[Request] = None) -> None:
         """One fused decode dispatch: ``K = decode_chunk`` on-device
         steps, ONE host sync for the whole ``(K, num_slots)`` token
         block.  The host then walks each running request's column with
@@ -1069,7 +1512,7 @@ class ServeEngine:
         own finish never exist on the host side, and the slot-steps the
         device masked out are accounted in ``masked_slot_steps``."""
         if self._persistent:
-            return self._persistent_step()
+            return self._persistent_step(skip)
         running = self.scheduler.running
         k_steps = self.decode_chunk
         program = self._decode_program()
@@ -1099,9 +1542,16 @@ class ServeEngine:
         self.metrics.count("host_syncs")
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", k_steps)
+        self._record_tp_collectives(self.num_slots, k_steps)
         now = time.monotonic()
         emitted = 0
         for req in running:
+            if req is skip or not self.cache.active[req.slot]:
+                # not yet cache-admitted: the mid-chunked-prefill request
+                # itself (parked, device-frozen) or a same-batch admit an
+                # interleaved dispatch ran ahead of — their tokens start
+                # at their own prefill, not here
+                continue
             slot = req.slot
             took = 0
             for j in range(k_steps):
@@ -1129,7 +1579,7 @@ class ServeEngine:
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
 
-    def _persistent_step(self) -> None:
+    def _persistent_step(self, skip: Optional[Request] = None) -> None:
         """One persistent-loop dispatch: the while_loop runs on-device
         until every slot's finish bit sets or the ring fills, then the
         host drains the ring — ONE sync for the whole wave, the pending
@@ -1193,6 +1643,7 @@ class ServeEngine:
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", n_it)
         self.metrics.count("loop_iterations", n_it)
+        self._record_tp_collectives(self.num_slots, n_it)
         self.metrics.observe_ring(n_it)
         now = time.monotonic()
         # streamed tail (opt-in): the iteration-0 callback timestamp is
@@ -1204,6 +1655,9 @@ class ServeEngine:
         emitted = 0
         any_cut = False
         for req in running:
+            if req is skip:
+                # mid-chunked-prefill request: parked, device-frozen
+                continue
             slot = req.slot
             taken = 0
             finished = False

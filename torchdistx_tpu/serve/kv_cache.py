@@ -225,6 +225,10 @@ class SlotKVCache(_HostBookkeeping):
         # placement must agree with the params' devices (mixed committed
         # device sets are a jit error), so the engine derives it from the
         # params (replicated over their mesh when they are sharded).
+        # Under ServeEngine(mesh=) the placement is a NamedSharding that
+        # shards the Hkv axis over tp — each device commits only its
+        # Hkv/tp head slice; everything host-side here (lengths, active,
+        # page tables) is per-slot metadata and never sharded.
         self.kv = jax.device_put(
             model.init_cache(self.num_slots, self.max_len),
             placement if placement is not None else jax.devices()[0],

@@ -91,7 +91,12 @@ class ServeMetrics:
     token, THE number the fused decode loop exists to shrink),
     ``masked_slot_steps`` (slot-steps the on-device finish mask threw
     away because a request finished mid-chunk: the wasted-work side of
-    the host-sync tradeoff), the persistent-loop set —
+    the host-sync tradeoff), the chunked-prefill set —
+    ``chunked_prefills`` (long-prompt admissions split into chunks),
+    ``prefill_chunks`` (chunk dispatches those admissions made) and
+    ``prefill_interleaved_dispatches`` (decode dispatches interleaved
+    between chunks so active slots keep emitting during a long
+    admission) — the persistent-loop set —
     ``loop_iterations`` (on-device while_loop iterations across all
     persistent dispatches — equals ``decode_steps`` in persistent mode),
     ``ring_drains`` (loop exits whose output ring the host drained; in
@@ -165,6 +170,9 @@ class ServeMetrics:
             "tokens_generated": 0,
             "tokens_decoded": 0,
             "prefill_calls": 0,
+            "chunked_prefills": 0,
+            "prefill_chunks": 0,
+            "prefill_interleaved_dispatches": 0,
             "decode_steps": 0,
             "decode_dispatches": 0,
             "host_syncs": 0,
