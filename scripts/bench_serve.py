@@ -8,7 +8,15 @@ dispatch, one sync per K tokens), then — with ``persistent`` in
 ``--decode-mode`` (the default) — the persistent whole-loop phase (one
 ``lax.while_loop`` dispatch per generation wave, host syncs = ring
 drains only; its summary carries ``syncs_reduction_vs_k16`` against the
-K=16 fused baseline that ran before it), then — with ``--prefix-share``
+K=16 fused baseline that ran before it), then — with ``--speculate
+0,2,4`` — one persistent-loop phase per K on a repetition-heavy workload
+(the prompt-lookup drafter's food), K=0 FIRST as the baseline leg; the
+K>0 summaries carry ``accepted_tokens_per_iteration`` and
+``loop_iterations_reduction_vs_spec0``, and a K>0 phase flags ``error``
+unless it accepted more than one token per iteration, ran strictly fewer
+loop iterations than spec0, and kept ``host_syncs`` EXACTLY equal to the
+baseline's (speculation multiplies tokens per sync; it may never add
+one); then — with ``--prefix-share``
 — one paged-engine phase that runs the SAME repeated-system-prompt burst
 twice through one engine: cold (empty prefix index) and warm (index
 populated by the cold pass).  Warm prefill must compute strictly fewer padded
@@ -106,6 +114,20 @@ def _parse_args():
         "max_len — one drain per generation wave)",
     )
     ap.add_argument(
+        "--speculate",
+        default="",
+        help="comma-separated self-speculation depths to A/B through the "
+        "persistent loop on a repetition-heavy workload (e.g. '0,2,4'); "
+        "the K=0 baseline leg always runs first, like the K=1 fused "
+        "baseline",
+    )
+    ap.add_argument(
+        "--spec-ngram",
+        type=int,
+        default=2,
+        help="prompt-lookup n-gram width for the --speculate phases",
+    )
+    ap.add_argument(
         "--prefix-share",
         action="store_true",
         help="append a paged-engine phase A/Bing a repeated-system-prompt "
@@ -157,6 +179,18 @@ def _chunk_values(args) -> list:
     return [1] + [k for k in dict.fromkeys(ks) if k != 1]
 
 
+def _spec_values(args) -> list:
+    """The ``--speculate`` sweep: K=0 (the classic persistent program)
+    always FIRST so a wedge mid-sweep still leaves the baseline leg of
+    the A/B, then the deduped K>0 depths."""
+    ks = [int(k) for k in str(args.speculate).split(",") if str(k).strip()]
+    if not ks:
+        return []
+    if any(k < 0 for k in ks):
+        raise SystemExit(f"--speculate values must be >= 0, got {ks}")
+    return [0] + [k for k in dict.fromkeys(ks) if k != 0]
+
+
 def _phase_summary(rec: dict) -> dict:
     """The A/B headline numbers of one phase record, lifted out of its
     embedded ``metrics`` (``ServeMetrics.to_json()``) object."""
@@ -190,6 +224,19 @@ def _phase_summary(rec: dict) -> dict:
             ring_drains=counters.get("ring_drains"),
             loop_iterations=counters.get("loop_iterations"),
             ring_occupancy_hwm=gauges.get("ring_occupancy_hwm"),
+        )
+    if rec.get("speculate") is not None:  # the self-speculation A/B
+        out.update(
+            speculate=rec.get("speculate"),
+            accept_rate=derived.get("accept_rate"),
+            accepted_tokens_per_iteration=derived.get(
+                "accepted_tokens_per_iteration"
+            ),
+            draft_tokens_proposed=counters.get("draft_tokens_proposed"),
+            draft_tokens_accepted=counters.get("draft_tokens_accepted"),
+            loop_iterations_reduction_vs_spec0=rec.get(
+                "loop_iterations_reduction_vs_spec0"
+            ),
         )
     if "warm" in rec:  # the prefix-share phase
         out.update(
@@ -230,6 +277,7 @@ def _supervise(args) -> None:
         # the persistent A/B still needs its fused baselines: K=1 (the
         # sweep's anchor) and the largest requested K (the comparator)
         chunks = [1] + ([chunks[-1]] if chunks[-1] != 1 else [])
+    specs = _spec_values(args)
     record: dict = {
         "bench": "serve",
         # commit + schema attribution (the perf-sentinel requirement:
@@ -239,6 +287,7 @@ def _supervise(args) -> None:
         "deadline_s": deadline,
         "decode_chunks": chunks,
         "decode_modes": modes,
+        "speculate_sweep": specs,
         "mesh": args.tp,
         "phases": {},
     }
@@ -248,6 +297,16 @@ def _supervise(args) -> None:
     plan = [(f"k{k}", {"TDX_SERVE_CHUNK": str(k)}) for k in chunks]
     if "persistent" in modes:
         plan.append(("persistent", {"TDX_SERVE_PHASE": "persistent"}))
+    for k in specs:
+        plan.append(
+            (
+                f"spec{k}",
+                {
+                    "TDX_SERVE_PHASE": "speculate",
+                    "TDX_SERVE_SPECULATE": str(k),
+                },
+            )
+        )
     if args.prefix_share:
         plan.append(
             (
@@ -270,6 +329,37 @@ def _supervise(args) -> None:
         )
 
     def emit():
+        # the speculation A/B verdict, before the summary snapshots it:
+        # a K>0 leg must beat spec0 on iteration economy WITHOUT moving
+        # the sync count (speculation multiplies tokens per sync — one
+        # extra host sync means the engine broke the drain discipline).
+        # Idempotent across the per-phase emits: same inputs, same
+        # fields, and a flagged error short-circuits further rewrites.
+        spec0 = record["phases"].get("spec0") or {}
+        base_c = (spec0.get("metrics") or {}).get("counters") or {}
+        for name, rec in record["phases"].items():
+            if not (name.startswith("spec") and name != "spec0"):
+                continue
+            if "error" in rec or "error" in spec0 or not base_c:
+                continue
+            c = (rec.get("metrics") or {}).get("counters") or {}
+            it, base_it = c.get("loop_iterations"), base_c.get(
+                "loop_iterations"
+            )
+            rec["loop_iterations_reduction_vs_spec0"] = (
+                round(base_it / it, 3) if it and base_it else None
+            )
+            if it and base_it and not it < base_it:
+                rec["error"] = (
+                    "speculation did not reduce loop iterations "
+                    f"({it} vs {base_it} at spec0)"
+                )
+            elif c.get("host_syncs") != base_c.get("host_syncs"):
+                rec["error"] = (
+                    "speculation changed the host sync count "
+                    f"({c.get('host_syncs')} vs "
+                    f"{base_c.get('host_syncs')} at spec0)"
+                )
         # phases run (and are recorded) in plan order; dict order is the
         # summary order
         record["summary"] = {
@@ -687,6 +777,128 @@ def _child(args) -> None:
     print(json.dumps(record))
 
 
+def _child_spec(args) -> None:
+    """One leg of the self-speculation A/B: a persistent-loop engine at
+    ``speculate=K`` (K=0 compiles the classic persistent program — the
+    baseline leg) over a repetition-heavy workload, the shape
+    prompt-lookup drafting feeds on (vLLM's ngram speculator makes the
+    same bet).  The prompts are period-1..4 cycles and every leg draws
+    them from the same seeded stream, so the K legs serve the IDENTICAL
+    workload and greedy bit-identity (pinned by tests) makes their
+    token streams — and therefore token totals — comparable.  The
+    headline is iteration economy: ``accepted_tokens_per_iteration``
+    must clear 1.0 (flagged ``error`` here otherwise), and the
+    supervisor cross-checks strictly-fewer ``loop_iterations`` plus an
+    unchanged ``host_syncs`` against the spec0 leg."""
+    spec_k = int(os.environ.get("TDX_SERVE_SPECULATE", "0"))
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="speculate", speculate=spec_k, spec_ngram=args.spec_ngram
+    )
+    record["decode_mode"] = "persistent"
+
+    import numpy as np
+
+    from torchdistx_tpu import obs
+    from torchdistx_tpu.serve import ServeEngine
+
+    watcher = obs.RecompileWatcher()
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        # a cycle only earns acceptance once it has RECURRED in the
+        # history: give every request enough budget to get past the
+        # first occurrence even on the tiny-model smoke geometry
+        spec_new = min(max(args.max_new, 24), limit // 2)
+        max_len = args.max_len or min(limit, 8 * spec_new)
+        engine_kw: dict = dict(
+            decode_mode="persistent", ring_capacity=args.ring
+        )
+        if spec_k:
+            engine_kw.update(speculate=spec_k, spec_ngram=args.spec_ngram)
+        engine = ServeEngine(
+            model,
+            num_slots=args.slots,
+            max_len=max_len,
+            **engine_kw,
+            **_mesh_kwargs(args),
+        )
+        record["ring_capacity"] = engine.ring_capacity
+        record["max_new_tokens"] = spec_new
+        rs = np.random.RandomState(0)
+        max_prompt = max(2, min(max_len - spec_new, max_len // 2))
+        prompts = []
+        for _ in range(args.requests):
+            period = int(rs.randint(1, 5))
+            pat = rs.randint(0, 256, (period,)).astype(np.int32)
+            plen = int(rs.randint(period + 1, max_prompt + 1))
+            prompts.append(np.tile(pat, -(-plen // period))[:plen])
+
+        # warm every reachable program past the donated-carry recompile
+        # (CLAUDE.md: never time the second call) — same discipline as
+        # the fused/persistent phases
+        warm_new = min(8, max_len - max_prompt)
+        for b in engine.prefill_buckets:
+            plen = max(1, min(b, max_prompt))
+            for j in range(2):
+                engine.run([
+                    {"prompt": rs.randint(0, 256, (plen,)).astype(np.int32),
+                     "max_new_tokens": warm_new,
+                     "temperature": args.temperature,
+                     "seed": 10**6 + 2 * j + i}
+                    for i in range(2)
+                ])
+            if plen < b:
+                break
+        engine.reset_metrics()
+        record["recompile_warmup"] = watcher.snapshot()
+        watcher.reset()  # the measured window must compile NOTHING
+
+        from torchdistx_tpu.obs.comm import comm_audit
+
+        t0 = time.perf_counter()
+        with comm_audit() as comm_prof:
+            results = engine.run(
+                [
+                    {
+                        "prompt": p,
+                        "max_new_tokens": spec_new,
+                        "temperature": args.temperature,
+                        "seed": i,
+                    }
+                    for i, p in enumerate(prompts)
+                ]
+            )
+        wall = time.perf_counter() - t0
+
+        record["comm"] = comm_prof.to_json()
+        m = engine.metrics.to_json()
+        record["metrics"] = m
+        record["accept_rate"] = m["derived"]["accept_rate"]
+        record["accepted_tokens_per_iteration"] = m["derived"][
+            "accepted_tokens_per_iteration"
+        ]
+        _embed_cost(record, engine)
+        record["recompile_measure"] = watcher.snapshot()
+        record.update(
+            max_len=max_len,
+            drain_wall_s=round(wall, 3),
+            compiled_programs=engine.num_compiled_programs(),
+            prompt_tokens=int(sum(p.size for p in prompts)),
+            finish_reasons=sorted({r.finish_reason for r in results}),
+            kv_cache_gb=round(engine.cache.nbytes / 1e9, 3),
+        )
+        atpi = record["accepted_tokens_per_iteration"]
+        if spec_k and not (atpi or 0) > 1.0:
+            record["error"] = (
+                "speculation accepted no drafts "
+                f"(accepted_tokens_per_iteration={atpi})"
+            )
+        _dump_obs(record, engine, f"spec{spec_k}")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def _child_prefix(args) -> None:
     """The shared-prefix A/B phase: ONE paged engine, the SAME
     repeated-system-prompt burst twice — cold (empty radix index) then
@@ -1002,6 +1214,8 @@ def main() -> None:
             _child_prefix(args)
         elif phase == "chunked_prefill":
             _child_chunked_prefill(args)
+        elif phase == "speculate":
+            _child_spec(args)
         else:
             _child(args)
     else:

@@ -9,15 +9,18 @@ processes), every measurement the round needs from a relay-alive window:
                               (BENCH_SERVE_TPU.json does not exist yet):
                               fused K sweep + persistent-loop A/B +
                               shared-prefix cold/warm
-3. ``bench_flash_attention``— corrected long-context fwd+bwd rows
+3. ``bench_serve --speculate 0,2,4`` — self-speculative decode A/B
+                              through the persistent loop
+                              (BENCH_SERVE_TPU_SPEC.json)
+4. ``bench_flash_attention``— corrected long-context fwd+bwd rows
                               (the round-3 32k/64k rows were invalidated
                               by gradient DCE; the harness now consumes
                               every gradient)
-4. ``bench_fused_ce``       — kernel-level fused-vs-unfused loss A/B
-5. ``bench.py --train-phase`` with TDX_BENCH_OPT=8bit      — optimizer A/B
-6. ``bench.py --train-phase`` with REMAT=1 x {full, dots}  — remat A/B
-7. ``bench_generate``       — int8 decode A/B
-8. ``bench_t5_train``       — biased-kernel train delta
+5. ``bench_fused_ce``       — kernel-level fused-vs-unfused loss A/B
+6. ``bench.py --train-phase`` with TDX_BENCH_OPT=8bit      — optimizer A/B
+7. ``bench.py --train-phase`` with REMAT=1 x {full, dots}  — remat A/B
+8. ``bench_generate``       — int8 decode A/B
+9. ``bench_t5_train``       — biased-kernel train delta
 
 Each step is a subprocess under its own slice of a global deadline
 (``TDX_CAMPAIGN_DEADLINE``, default 5400 s); stdout JSON lines are
@@ -92,6 +95,23 @@ def _steps() -> list:
              "--max-len", "64"] if smoke
             else ["--tp", "1", "--chunked-prefill", "256"]),
          {} if smoke else {"TDX_BENCH_DEADLINE": "800"}, 900),
+        # self-speculation A/B (ISSUE 11): spec0 baseline vs K=2,4
+        # through the persistent loop on the repetition-heavy workload —
+        # the first on-chip evidence of whether prompt-lookup drafting
+        # pays on the relay (each accepted draft is one more token per
+        # while-loop iteration at zero extra host syncs).  Its own
+        # artifact: the serve_engine_ab record above keeps the canonical
+        # BENCH_SERVE_TPU.json name (smoke redirects to /tmp so the
+        # committed CPU record, pinned by the perf gate, is never
+        # clobbered by campaign-smoke geometry).
+        ("serve_spec_ab",
+         [py, os.path.join(sdir, "bench_serve.py"),
+          "--decode-mode", "persistent", "--speculate", "0,2,4"]
+         + (["--requests", "6", "--max-new", "8", "--slots", "2",
+             "--max-len", "64",
+             "--artifact", "/tmp/BENCH_SERVE_CPU_SPEC.json"] if smoke
+            else ["--artifact", "BENCH_SERVE_TPU_SPEC.json"]),
+         {} if smoke else {"TDX_BENCH_DEADLINE": "700"}, 800),
         ("flash_long_context",
          [py, os.path.join(sdir, "bench_flash_attention.py")]
          + (["--seqs", "256"] if smoke else

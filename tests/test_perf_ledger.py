@@ -628,10 +628,21 @@ class TestCLIs:
         fps = set(doc["counters"])
         phase_fps = {fp for fp in fps if "program=" not in fp}
         cost_fps = fps - phase_fps
-        assert len(phase_fps) == 3  # k1 + k4 + persistent
+        # k1 + k4 + persistent + the ISSUE 11 speculate sweep (spec0
+        # baseline rides at its own geometry — the spec phases stretch
+        # max_new so the self-repetition the n-gram drafter needs can
+        # establish, hence their own fingerprint family)
+        assert len(phase_fps) == 6
         for fp in fps:
-            assert "max_new_tokens=8" in fp and "requests=6" in fp
+            assert "requests=6" in fp
             assert "model=tiny" in fp and "num_slots=2" in fp
+        spec_fps = {fp for fp in phase_fps if "speculate=" in fp}
+        assert {fp.split("phase=")[1].split("|")[0] for fp in spec_fps} \
+            == {"spec0", "spec2", "spec4"}
+        for fp in spec_fps:
+            assert "decode_mode=persistent" in fp
+        for fp in fps - spec_fps:
+            assert "max_new_tokens=8" in fp or "program=" in fp
         assert any("phase=persistent" in fp for fp in phase_fps)
         # cost observatory (ISSUE 8): each phase additionally pins its
         # programs' XLA HLO-analysis counts under program-tagged

@@ -299,6 +299,274 @@ def _make_persistent_decode(
     return run
 
 
+def _make_spec_decode_body(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+    speculate: int,
+    ngram: int = 2,
+):
+    """Self-speculative draft/verify/accept decode body: the variable-
+    advance sibling of ``_make_decode_body``, shared by the fused scan
+    and the persistent while-loop exactly like the one-token body — so
+    fused-vs-persistent identity again holds by construction.
+
+    One iteration, entirely on device (no host sync is ever introduced —
+    the PyGraph whole-capture rule the persistent loop is built on):
+
+    1. DRAFT ``speculate`` candidate tokens per slot by prompt-lookup:
+       find the most recent earlier occurrence of the slot's trailing
+       ``ngram`` tokens in its own token history ``hist`` (the prompt +
+       everything generated; no second model, no new weights) and
+       propose the tokens that followed it.  A slot with no match
+       proposes garbage — harmless, it just verifies to an accept
+       length of 0.
+    2. VERIFY all ``speculate + 1`` positions in ONE batched
+       ``forward_decode`` call: the pending token plus the drafts ride
+       as a (B, K+1) query block through the same
+       ``slot_cached_attention`` path, each query row masked to its own
+       depth ``pos + i``.  Row 0's logits are bit-identical to the
+       one-token call's (every op on the path is query-row-independent),
+       which is what makes greedy spec-vs-nonspec streams bit-identical
+       rather than approximately equal.
+    3. ACCEPT the longest draft prefix whose tokens equal the greedy
+       targets of the previous row (``a`` matches ⇒ ``e = a + 1`` tokens
+       emitted: the accepted drafts plus the one "free" token the
+       verify computed after them).  Sampled rows (``temps > 0``) force
+       ``a = 0`` so they advance exactly one token per iteration and
+       the ``fold_in(seed, step)`` key schedule is untouched.  ``e`` is
+       then truncated on device by the SAME finish rules the host walk
+       applies — first EOS inside the block, remaining budget, cache
+       end — so a slot can only finish at the LAST token of an
+       iteration and the host re-derives identical finish reasons.
+
+    KV safety under variable advance (the PR 3/6 frozen-write argument
+    extended): the verify writes rows ``pos .. pos + K`` for every slot.
+    Rows ``pos .. pos + e - 1`` hold K/V of exactly the accepted stream
+    (the acceptance test guarantees the written candidates equal the
+    true greedy continuation); rows ``pos + e .. pos + K`` hold
+    rejected-lane K/V, but ``pos`` advances only by ``e``, so they sit
+    beyond the slot's live depth and the next iteration's verify
+    rewrites them before the visibility mask can ever reach them
+    (overwrite-before-visible).  Rows past ``max_len`` are DROPPED by
+    the multi-token scatter (``serve/kv_cache.py``) rather than clamped
+    — a clamp would corrupt the last row, a flat unclamped scatter
+    would collide into the next slot.
+
+    ``step(params, temps, seeds, budgets, extra, carry)`` takes carry
+    ``(kv, tok, pos, stp, fin, hist)`` — the one-token carry plus the
+    (B, max_len) int32 token history — and returns ``(carry, y_block,
+    cnt)``: the (B, K+1) verified token block and the per-slot emitted
+    count (0 for frozen slots, else ``e``).  At ``e == 1`` every carry
+    update reduces exactly to ``_make_decode_body``'s.
+    """
+
+    if speculate < 1:
+        raise ValueError(f"speculate must be >= 1, got {speculate}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+
+    def step(params, temps, seeds, budgets, extra, carry):
+        kv, tok, pos, stp, fin, hist = carry
+        b = tok.shape[0]
+        rows = jnp.arange(b)
+        # the pending token enters the history at its own stream index.
+        # Idempotent for host-known tokens; load-bearing for persistent
+        # mode's deferred first tokens, which the host never saw.
+        hist = hist.at[rows, jnp.clip(pos, 0, max_len - 1)].set(tok)
+
+        # -- draft: most recent earlier occurrence of the trailing n-gram
+        idx = jnp.arange(max_len)[None, :]
+        match = (idx >= ngram - 1) & (idx < pos[:, None])
+        for d in range(ngram):
+            shifted = (
+                hist
+                if d == 0
+                else jnp.pad(hist, ((0, 0), (d, 0)))[:, :max_len]
+            )
+            tgt = jnp.take_along_axis(
+                hist, jnp.clip(pos - d, 0, max_len - 1)[:, None], axis=1
+            )
+            match = match & (shifted == tgt)
+        j_best = jnp.max(jnp.where(match, idx, -1), axis=1)
+        draft = jnp.take_along_axis(
+            hist,
+            jnp.clip(
+                j_best[:, None] + 1 + jnp.arange(speculate)[None, :],
+                0,
+                max_len - 1,
+            ),
+            axis=1,
+        ).astype(tok.dtype)
+
+        # -- verify: one (B, K+1) forward through slot_cached_attention
+        qtok = jnp.concatenate([tok[:, None], draft], axis=1)
+        logits, kv = functional_call(
+            model, params, (qtok, kv, pos) + extra, method="forward_decode"
+        )
+        y1 = sampler(logits[:, 0, :], temps, seeds, stp)
+        gre = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        y_block = jnp.concatenate([y1[:, None], gre[:, 1:]], axis=1)
+
+        # -- accept: longest draft prefix matching the greedy targets;
+        # sampled rows pin the accept length to 0 (key schedule intact)
+        m = (qtok[:, 1:] == y_block[:, :speculate]) & (temps <= 0.0)[:, None]
+        acc = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+        e = acc + 1
+        jj = jnp.arange(1, speculate + 2)[None, :]
+        if eos_token is not None:
+            first_eos = jnp.min(
+                jnp.where(y_block == eos_token, jj, speculate + 2), axis=1
+            )
+            e = jnp.minimum(e, first_eos)
+        e = jnp.minimum(e, budgets - stp)
+        e = jnp.minimum(e, max_len - pos)
+        e = jnp.maximum(e, 1)
+        last = jnp.take_along_axis(y_block, (e - 1)[:, None], axis=1)[:, 0]
+
+        # emitted tokens extend the history; rejected lanes and frozen
+        # slots are dropped, rows past max_len are dropped
+        tgt_idx = pos[:, None] + jj
+        writable = (
+            (jj <= e[:, None]) & (~fin)[:, None] & (tgt_idx < max_len)
+        )
+        hist = hist.at[
+            rows[:, None], jnp.where(writable, tgt_idx, max_len)
+        ].set(y_block.astype(hist.dtype), mode="drop")
+
+        new_tok = jnp.where(fin, tok, last)
+        new_stp = jnp.where(fin, stp, stp + e)
+        hit_eos = (
+            (last == eos_token)
+            if eos_token is not None
+            else jnp.zeros_like(fin)
+        )
+        hit_len = new_stp >= budgets
+        hit_full = pos + e >= max_len
+        new_fin = fin | hit_eos | hit_len | hit_full
+        new_pos = jnp.where(fin, pos, jnp.clip(pos + e, 0, max_len - 1))
+        cnt = jnp.where(fin, 0, e).astype(jnp.int32)
+        return (kv, new_tok, new_pos, new_stp, new_fin, hist), y_block, cnt
+
+    return step
+
+
+def _make_fused_spec_decode(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+    decode_chunk: int,
+    speculate: int,
+    ngram: int = 2,
+):
+    """Fused K-iteration speculative decode: ``_make_spec_decode_body``
+    under a ``decode_chunk``-length ``lax.scan``.  Each scan step emits
+    the full (B, K+1) verified block plus the per-slot emitted count, so
+    the host walk can consume a VARIABLE number of tokens per iteration
+    per slot while the device shapes stay static.
+
+    Returns ``run(params, kv, toks, positions, hist, temps, seeds,
+    steps, budgets, finished, *extra) -> (kv, (chunk, B, K+1) token
+    blocks, (chunk, B) counts)``.
+    """
+
+    step = _make_spec_decode_body(
+        model,
+        sampler,
+        eos_token=eos_token,
+        max_len=max_len,
+        speculate=speculate,
+        ngram=ngram,
+    )
+
+    def run(params, kv, toks, positions, hist, temps, seeds, steps,
+            budgets, finished, *extra):
+        def body(carry, _):
+            carry, y_block, cnt = step(
+                params, temps, seeds, budgets, extra, carry
+            )
+            return carry, (y_block, cnt)
+
+        (kv, _, _, _, _, _), (ys, cs) = jax.lax.scan(
+            body, (kv, toks, positions, steps, finished, hist), None,
+            length=decode_chunk,
+        )
+        return kv, ys, cs
+
+    return run
+
+
+def _make_persistent_spec_decode(
+    model: Any,
+    sampler,
+    *,
+    eos_token: Optional[int],
+    max_len: int,
+    ring_capacity: int,
+    speculate: int,
+    ngram: int = 2,
+):
+    """Persistent speculative decode: the SAME ``_make_spec_decode_body``
+    under the ``lax.while_loop`` fixpoint drive of
+    ``_make_persistent_decode``.  The output ring widens to one (B, K+1)
+    verified block per iteration plus a (ring_capacity, B) count ring —
+    ``cnts[it, b] > 0`` is the old valid mask, and its value is how many
+    of the block's tokens slot ``b`` actually emitted.  One ring row per
+    ITERATION (not per token): ring capacity still bounds iterations,
+    each worth up to K+1 tokens, and ``host_syncs == ring_drains``
+    exactly as before — speculation multiplies tokens per sync, it never
+    adds a sync.
+
+    Returns ``run(params, kv, toks, positions, hist, temps, seeds,
+    steps, budgets, active, *extra) -> (kv, ring, cnts, iterations)``.
+    """
+
+    step = _make_spec_decode_body(
+        model,
+        sampler,
+        eos_token=eos_token,
+        max_len=max_len,
+        speculate=speculate,
+        ngram=ngram,
+    )
+
+    def run(params, kv, toks, positions, hist, temps, seeds, steps,
+            budgets, active, *extra):
+        fin0 = (~active) | (steps >= budgets)
+        if eos_token is not None:
+            fin0 = fin0 | (toks == eos_token)
+        b = toks.shape[0]
+        ring0 = jnp.zeros((ring_capacity, b, speculate + 1), toks.dtype)
+        cnt0 = jnp.zeros((ring_capacity, b), jnp.int32)
+
+        def cond(carry):
+            (_, _, _, _, fin, _), _, _, it = carry
+            return jnp.logical_and(~jnp.all(fin), it < ring_capacity)
+
+        def body(carry):
+            inner, ring, cnts, it = carry
+            inner, y_block, cnt = step(
+                params, temps, seeds, budgets, extra, inner
+            )
+            ring = jax.lax.dynamic_update_index_in_dim(ring, y_block, it, 0)
+            cnts = jax.lax.dynamic_update_index_in_dim(cnts, cnt, it, 0)
+            return (inner, ring, cnts, it + 1)
+
+        (kv, _, _, _, _, _), ring, cnts, it = jax.lax.while_loop(
+            cond,
+            body,
+            ((kv, toks, positions, steps, fin0, hist), ring0, cnt0,
+             jnp.int32(0)),
+        )
+        return kv, ring, cnts, it
+
+    return run
+
+
 def _decode_tokens(
     apply_step: Callable[[jax.Array, Any, Any], tuple],
     sample,

@@ -226,11 +226,22 @@ class GPT2(nn.Module):
 
     def forward_decode(self, tokens, cache, positions, page_tables=None):
         """One decode step for a batch of independent serving slots:
-        ``tokens`` (B, 1), ``positions`` (B,) int32 per-row cache depths.
-        With ``page_tables`` the cache pytree is the per-layer page
-        pools (``serve/kv_cache.py``).  Returns (logits, new_cache);
-        same cache pytree as it was given."""
-        x = self.tok_emb(tokens) + self.pos_emb(positions)[:, None]
+        ``tokens`` (B, S), ``positions`` (B,) int32 per-row cache depths
+        (token ``(b, i)`` sits at depth ``positions[b] + i``; ``S > 1``
+        is the speculative verify block).  With ``page_tables`` the
+        cache pytree is the per-layer page pools (``serve/kv_cache.py``).
+        Returns (logits, new_cache); same cache pytree as it was
+        given."""
+        s = tokens.shape[1]
+        if s == 1:
+            x = self.tok_emb(tokens) + self.pos_emb(positions)[:, None]
+        else:
+            pos = jnp.clip(
+                positions[:, None] + jnp.arange(s)[None, :],
+                0,
+                self.cfg.n_positions - 1,
+            )
+            x = self.tok_emb(tokens) + self.pos_emb(pos)
         new_cache = []
         for blk, c in zip(self.blocks, cache):
             x, c = blk.forward_decode(x, c, positions, page_tables)

@@ -176,13 +176,26 @@ def apply_rope(x: jax.Array, rope: jax.Array, offset=0) -> jax.Array:
 
 
 def apply_rope_at(x: jax.Array, rope: jax.Array, positions: jax.Array) -> jax.Array:
-    """x: (B, 1, H, D); ``positions``: (B,) int32 — PER-ROW rotary offsets
+    """x: (B, S, H, D); ``positions``: (B,) int32 — PER-ROW rotary offsets
     (continuous-batching decode: each batch row is a serving slot at its
-    own depth).  Row ``b`` gets the same rotation ``apply_rope`` would
-    apply at scalar offset ``positions[b]``."""
-    window = jnp.take(rope, positions, axis=0)  # (B, D/2, 2)
-    cos = window[:, None, None, :, 0]
-    sin = window[:, None, None, :, 1]
+    own depth).  Token ``(b, i)`` gets the same rotation ``apply_rope``
+    would apply at scalar offset ``positions[b] + i`` (``S == 1`` is the
+    plain decode step; ``S > 1`` is the speculative verify block, whose
+    per-row offsets clamp at the table end exactly like ``jnp.take``'s
+    default clip mode on the single-token path — those rows are
+    rejected-lane only)."""
+    s = x.shape[1]
+    if s == 1:
+        window = jnp.take(rope, positions, axis=0)  # (B, D/2, 2)
+        cos = window[:, None, None, :, 0]
+        sin = window[:, None, None, :, 1]
+    else:
+        pos_grid = jnp.clip(
+            positions[:, None] + jnp.arange(s)[None, :], 0, rope.shape[0] - 1
+        )
+        window = rope[pos_grid]  # (B, S, D/2, 2)
+        cos = window[:, :, None, :, 0]
+        sin = window[:, :, None, :, 1]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)

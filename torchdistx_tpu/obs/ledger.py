@@ -290,6 +290,7 @@ _SERVE_WORKLOAD_KEYS = (
     "max_len",
     "mesh",
     "chunked_prefill",
+    "speculate",
 )
 
 
@@ -357,6 +358,12 @@ def ingest_serve_record(record: dict, **kw) -> List[dict]:
         # counters ⇒ same double, so they gate exactly too
         row("syncs_per_token", derived.get("syncs_per_token"), "counter")
         row("prefix_hit_rate", derived.get("prefix_hit_rate"), "counter")
+        row("accept_rate", derived.get("accept_rate"), "counter")
+        row(
+            "accepted_tokens_per_iteration",
+            derived.get("accepted_tokens_per_iteration"),
+            "counter",
+        )
         row(
             "decode_tokens_per_sec",
             derived.get("decode_tokens_per_sec"),

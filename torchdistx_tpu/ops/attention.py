@@ -200,6 +200,34 @@ def _slot_attend(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
 
 
+def _slot_attend_block(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    positions: jax.Array,
+    scale: Optional[float],
+) -> jax.Array:
+    """Multi-token sibling of :func:`_slot_attend` for the speculative
+    verify block: ``q`` is (B, S, Hq, D) and query row ``i`` of slot
+    ``b`` attends cache rows ``j <= positions[b] + i`` — the per-slot
+    shift of :func:`cached_attention`'s S-token visibility template.
+    Same ``_repeat_kv`` + einsum + f32-softmax op chain as
+    ``_slot_attend``; every op is row-independent, so row 0 is bitwise
+    the S == 1 result (the spec bit-identity contract)."""
+    b, s, hq, d = q.shape
+    max_seq, hkv = ck.shape[1], ck.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+    slots = jnp.arange(max_seq)[None, None, :]
+    depths = positions[:, None] + jnp.arange(s)[None, :]  # (B, S)
+    visible = slots <= depths[:, :, None]  # (B, S, max_seq)
+    logits = jnp.where(visible[:, None, :, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+
+
 def slot_cached_attention(
     q: jax.Array,
     k_new: jax.Array,
@@ -219,9 +247,13 @@ def slot_cached_attention(
     K/V are written at ``positions[b]`` and its query attends cache
     slots ``j <= positions[b]``.
 
-    ``q``/``k_new``/``v_new``: (B, 1, H, D) projections of each slot's
-    next token (positional encoding already applied at that slot's own
-    position).  ``cache`` is ``(k, v)`` of shape (B, max_seq, Hkv, D);
+    ``q``/``k_new``/``v_new``: (B, S, H, D) projections of each slot's
+    next token(s), positional encoding already applied at that slot's
+    own position(s).  ``S == 1`` is the plain decode step; ``S > 1`` is
+    the speculative verify block (``ServeEngine(speculate=K)`` passes
+    ``S = K + 1`` candidates), where row ``i`` writes at
+    ``positions[b] + i`` and attends ``j <= positions[b] + i``.
+    ``cache`` is ``(k, v)`` of shape (B, max_seq, Hkv, D);
     ``positions`` is (B,) int32.  Row-for-row this is exactly the
     ``s == 1`` path of :func:`cached_attention` (same write, same
     visibility rule, f32 softmax), so a slot's decode stream is
@@ -254,15 +286,60 @@ def slot_cached_attention(
     (the engine-level contract tests/test_serve.py pins).
     """
     b, s, hq, d = q.shape
-    if s != 1:
-        raise ValueError(
-            f"slot_cached_attention decodes one token per slot, got S={s}"
-        )
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     ck, cv = cache
     from .flash_attention import resolve_use_flash
 
+    if s != 1:
+        # Speculative verify block (ServeEngine(speculate=K)): S = K + 1
+        # candidate tokens per slot, query row i masked to its OWN depth
+        # positions[b] + i.  Every op on this path is query-row
+        # independent, so row i's output is bit-identical to the S == 1
+        # call at position positions[b] + i with the same cache prefix —
+        # the property the engine's greedy spec-vs-nonspec bit-identity
+        # contract rests on.  Writes go through the multi-token scatters
+        # (serve/kv_cache.py): rows past max_len are dropped, never
+        # clamped or wrapped.
+        if window is not None:
+            raise ValueError(
+                f"multi-token slot decode does not support window "
+                f"(got S={s}, window={window})"
+            )
+        from ..serve.kv_cache import (
+            paged_scatter_tokens,
+            scatter_slot_tokens,
+        )
+
+        if page_tables is not None:
+            ps = ck.shape[1]
+            pp = page_tables.shape[1]
+            ck = paged_scatter_tokens(ck, k_new, page_tables, positions, ps)
+            cv = paged_scatter_tokens(cv, v_new, page_tables, positions, ps)
+            if ps >= 8 and resolve_use_flash(use_flash):
+                from .decode_attention import paged_decode_attention_block
+
+                out = paged_decode_attention_block(
+                    q, ck, cv, page_tables, positions, scale=scale
+                )
+                return out, (ck, cv)
+            flat = lambda c: c.reshape(-1, *c.shape[2:])  # noqa: E731
+            view_rows = (
+                page_tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+            ).reshape(b, pp * ps)
+            out = _slot_attend_block(
+                q, flat(ck)[view_rows], flat(cv)[view_rows], positions, scale
+            )
+            return out, (ck, cv)
+        ck = scatter_slot_tokens(ck, k_new, positions)
+        cv = scatter_slot_tokens(cv, v_new, positions)
+        if resolve_use_flash(use_flash):
+            from .decode_attention import decode_attention_block
+
+            out = decode_attention_block(q, ck, cv, positions, scale=scale)
+            return out, (ck, cv)
+        out = _slot_attend_block(q, ck, cv, positions, scale)
+        return out, (ck, cv)
     if page_tables is not None:
         ps = ck.shape[1]
         pp = page_tables.shape[1]

@@ -65,7 +65,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _CompilerParams, _shrink_block
 
-__all__ = ["decode_attention", "paged_decode_attention"]
+__all__ = [
+    "decode_attention",
+    "paged_decode_attention",
+    "decode_attention_block",
+    "paged_decode_attention_block",
+]
 
 _NEG_INF = -1e30
 _MIN_ROWS = 8  # f32 sublane minimum: GQA group rows pad up to this
@@ -236,6 +241,281 @@ def decode_attention(
         interpret=interpret,
     )(positions, qg, ck, cv)
     return out[:, :, :n_rep, :].reshape(b, 1, hq, d)
+
+
+def _decode_block_kernel(
+    pos_ref,  # scalar prefetch: (B,) int32 per-slot BASE depth
+    q_ref,  # (rows, D): S query tokens x n_rep GQA heads, row-major
+    k_ref,  # (block_k, D)
+    v_ref,  # (block_k, D)
+    o_ref,  # (rows, D)
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    block_k: int,
+    n_k: int,
+    s: int,
+    n_rep: int,
+):
+    """Speculative-verify sibling of ``_decode_kernel``: S > 1 candidate
+    tokens per slot ride as EXTRA MATMUL ROWS — row ``r`` is query token
+    ``r // n_rep`` of GQA head ``r % n_rep``, masked to its OWN depth
+    ``pos + r // n_rep``.  Same single-block exact-op-order fast path and
+    multi-block online-softmax merge as the one-token kernel; the only
+    new math is the per-row depth offset in the visibility mask (the
+    kernel analogue of ``_slot_attend_block``'s shifted mask)."""
+    b = pl.program_id(0)
+    kk = pl.program_id(2)
+    pos = pos_ref[b]
+
+    def tile(mask_value):
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        cols = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        # padded rows (row // n_rep >= s) mask like the last real token;
+        # their outputs are sliced off by the wrapper
+        depth = pos + jnp.minimum(row // n_rep, s - 1)
+        return jnp.where(cols <= depth, logits, mask_value)
+
+    if n_k == 1:
+        logits = tile(_NEG_INF)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        unnorm = jnp.exp(logits - m)
+        probs = unnorm / jnp.sum(unnorm, axis=-1, keepdims=True)
+        o_ref[...] = jax.lax.dot_general(
+            probs, v_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+        return
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # prune on the DEEPEST query row of the block: pos + s - 1
+    @pl.when(kk * block_k <= pos + (s - 1))
+    def _compute():
+        logits = tile(_NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * correction + jnp.sum(
+            p, axis=-1, keepdims=True
+        )
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p, v_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        o_ref[...] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _block_rows(q: jax.Array, hkv: int):
+    """Fold (B, S, Hq, D) into the block kernels' (B, Hkv, rows, D) row
+    layout — S tokens x n_rep GQA heads per KV group, padded up to the
+    f32 sublane minimum — and return the layout metadata."""
+    b, s, hq, d = q.shape
+    n_rep = hq // hkv
+    real = s * n_rep
+    rows = -(-real // _MIN_ROWS) * _MIN_ROWS
+    qg = q.reshape(b, s, hkv, n_rep, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, hkv, real, d)
+    if rows != real:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - real), (0, 0)))
+    return qg, rows, real, n_rep
+
+
+def _block_unfold(out: jax.Array, b, s, hq, d, hkv, n_rep, real):
+    return (
+        out[:, :, :real, :]
+        .reshape(b, hkv, s, n_rep, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, s, hq, d)
+    )
+
+
+def decode_attention_block(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Slot-paged MULTI-token decode attention (post-write): the
+    speculative verify block.  ``q``: (B, S, Hq, D) — ``S = K + 1``
+    candidate tokens per slot, query ``(b, i)`` masked to cache rows
+    ``j <= positions[b] + i``.  ``ck``/``cv``: the engine slab with all
+    S candidate K/V rows already scattered
+    (``serve/kv_cache.scatter_slot_tokens``).  Returns (B, S, Hq, D).
+
+    The S tokens fold into the GQA row axis (``rows = S * n_rep`` padded
+    to the sublane minimum), so the verify costs ONE kernel launch with
+    a slightly taller matmul instead of S launches — the whole point of
+    speculation.  The DMA clamp and block pruning use the block's
+    deepest row ``positions[b] + S - 1``.  The one-token kernel
+    (:func:`decode_attention`) is untouched; its S == 1 exactness
+    contract is pinned separately.
+    """
+    b, s, hq, d = q.shape
+    max_len, hkv = ck.shape[1], ck.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_k = _shrink_block(block_k, max_len)
+    n_k = max_len // block_k
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qg, rows, real, n_rep = _block_rows(q, hkv)
+    positions = positions.astype(jnp.int32)
+
+    def kv_index(bb, h, kk, pos_ref):
+        last = jnp.minimum(pos_ref[bb] + (s - 1), max_len - 1) // block_k
+        return (bb, jnp.minimum(kk, last), h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+            ),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_block_kernel,
+            scale=scale_, block_k=block_k, n_k=n_k, s=s, n_rep=n_rep,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(positions, qg, ck, cv)
+    return _block_unfold(out, b, s, hq, d, hkv, n_rep, real)
+
+
+def _paged_decode_block_kernel(
+    pos_ref, pt_ref, *refs, scale, block_k, n_k, s, n_rep
+):
+    """Paged twin of ``_decode_block_kernel`` — as with the one-token
+    pair, the page table lives entirely in the K/V index maps and the
+    in-block math is shared."""
+    del pt_ref
+    _decode_block_kernel(
+        pos_ref, *refs, scale=scale, block_k=block_k, n_k=n_k, s=s,
+        n_rep=n_rep,
+    )
+
+
+def paged_decode_attention_block(
+    q: jax.Array,
+    ck: jax.Array,
+    cv: jax.Array,
+    page_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Paged multi-token decode attention: :func:`decode_attention_block`
+    over the page pools, gathered page-by-page through the
+    scalar-prefetched table exactly like :func:`paged_decode_attention`
+    (block == page; pruning and the DMA clamp run in TABLE space on the
+    block's deepest row ``positions[b] + S - 1``)."""
+    b, s, hq, d = q.shape
+    ps, hkv = ck.shape[1], ck.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    if page_tables.shape[0] != b:
+        raise ValueError(
+            f"page_tables rows {page_tables.shape[0]} != batch {b}"
+        )
+    pp = page_tables.shape[1]
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qg, rows, real, n_rep = _block_rows(q, hkv)
+    positions = positions.astype(jnp.int32)
+    pt_flat = page_tables.astype(jnp.int32).reshape(-1)
+
+    def kv_index(bb, h, kk, pos_ref, pt_ref):
+        last = jnp.minimum(pos_ref[bb] + (s - 1), pp * ps - 1) // ps
+        page = pt_ref[bb * pp + jnp.minimum(kk, last)]
+        return (page, 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, pp),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, rows, d),
+                lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+            ),
+            pl.BlockSpec((None, ps, None, d), kv_index),
+            pl.BlockSpec((None, ps, None, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, rows, d),
+            lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_block_kernel,
+            scale=scale_, block_k=ps, n_k=pp, s=s, n_rep=n_rep,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(positions, pt_flat, qg, ck, cv)
+    return _block_unfold(out, b, s, hq, d, hkv, n_rep, real)
 
 
 def _paged_decode_kernel(pos_ref, pt_ref, *refs, scale, block_k, n_k):

@@ -98,7 +98,9 @@ from ..generation import (
     _cached_jit,
     _check_sampling_args,
     _make_fused_decode,
+    _make_fused_spec_decode,
     _make_persistent_decode,
+    _make_persistent_spec_decode,
     _make_slot_sampler,
 )
 from ..nn.module import functional_call
@@ -317,6 +319,25 @@ class ServeEngine:
         one of ``prefill_buckets`` (each full chunk reuses that bucket's
         program).  Token streams are unchanged — chunking only
         reschedules the prefill compute.  None (default) disables.
+      speculate: draft this many candidate tokens per slot per decode
+        iteration by SELF-speculation (prompt-lookup / n-gram drafting
+        against the slot's own token history — no second model), verify
+        all ``speculate + 1`` positions in ONE batched model call, and
+        accept the longest matching prefix greedily — entirely inside
+        the compiled decode body (``generation._make_spec_decode_body``),
+        so the persistent loop's sync discipline is untouched:
+        ``host_syncs`` still equals ring drains, each drain just carries
+        up to ``speculate + 1`` tokens per slot per iteration.  Greedy
+        streams stay bit-identical to ``speculate=0`` (row 0 of the
+        verify block IS the one-token forward; accepted rows match the
+        greedy argmax by construction); sampled slots (temperature > 0)
+        keep their exact key schedule by forcing accept length 0.  The
+        default 0 disables — the engine compiles the classic one-token
+        programs, byte-for-byte the pre-speculation dispatch.  See
+        docs/serving.md for choosing K.
+      spec_ngram: trailing-token match length for the draft lookup
+        (default 2).  Longer n-grams draft more conservatively (fewer,
+        better-grounded matches); 1 is aggressive last-token matching.
     """
 
     def __init__(
@@ -346,6 +367,8 @@ class ServeEngine:
         tp_rule: Optional[Any] = None,
         tp_axis: str = "tp",
         chunked_prefill: Optional[int] = None,
+        speculate: int = 0,
+        spec_ngram: int = 2,
     ):
         _check_sampling_args(top_k, top_p)
         cfg = getattr(model, "cfg", None)
@@ -429,6 +452,22 @@ class ServeEngine:
                     "persistent_stream requires decode_mode='persistent'"
                 )
             self.ring_capacity = None
+        if speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got {speculate}")
+        self.speculate = int(speculate)
+        self.spec_ngram = int(spec_ngram)
+        if self.speculate:
+            if self.spec_ngram < 1:
+                raise ValueError(
+                    f"spec_ngram must be >= 1, got {spec_ngram}"
+                )
+            if persistent_stream:
+                raise ValueError(
+                    "speculate is not supported with persistent_stream: "
+                    "the streamed tail pushes one token per iteration, "
+                    "but a speculative iteration emits a variable-length "
+                    "block only the drain walk can consume"
+                )
         if prefill_buckets is None:
             buckets = _default_buckets(self.max_len)
         else:
@@ -517,6 +556,7 @@ class ServeEngine:
             self.num_slots,
             num_pages=self.num_pages,
             ring_capacity=self.ring_capacity,
+            speculate=self.speculate or None,
         )
         self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
         # persistent mode: prefill defers its first-token fetch — the
@@ -536,6 +576,12 @@ class ServeEngine:
         self._seeds = np.zeros(self.num_slots, np.int32)
         self._ntok = np.zeros(self.num_slots, np.int32)  # tokens sampled
         self._budget = np.zeros(self.num_slots, np.int32)  # max_new_tokens
+        # speculative drafting history: the host mirror of each slot's
+        # full token stream (prompt + everything generated), shipped as
+        # a tiny int32 dynamic input to every spec decode dispatch — the
+        # device's n-gram draft lookup reads it, and the loop body keeps
+        # its on-device copy current across iterations within a dispatch
+        self._hist = np.zeros((self.num_slots, self.max_len), np.int32)
         # bounded history of finished requests, kept for per-request
         # trace export (dump_trace) — each carries its full lifecycle
         # event list and the timestamps the aggregate histograms used.
@@ -728,13 +774,15 @@ class ServeEngine:
 
     def reset_metrics(self) -> ServeMetrics:
         """Rebind ``self.metrics`` to a fresh :class:`ServeMetrics` with
-        THIS engine's geometry (slots, pages, ring) — the one correct
-        way to reset between bench passes; hand-constructing the object
-        would silently drop the paged/persistent gauge families."""
+        THIS engine's geometry (slots, pages, ring, speculate) — the one
+        correct way to reset between bench passes; hand-constructing the
+        object would silently drop the paged/persistent/speculative
+        gauge families."""
         self.metrics = ServeMetrics(
             self.num_slots,
             num_pages=self.num_pages,
             ring_capacity=self.ring_capacity,
+            speculate=self.speculate or None,
         )
         return self.metrics
 
@@ -1017,6 +1065,62 @@ class ServeEngine:
             out_shardings=self._out_shardings(3),
         )
 
+    def _spec_decode_program(self):
+        """The fused SPECULATIVE decode program
+        (``_make_fused_spec_decode``): one per ``(decode_chunk,
+        eos_token, speculate, spec_ngram)``.  A distinct key prefix from
+        the one-token program — a ``speculate=0`` engine never pays for
+        (or collides with) the spec body; the shared static-key suffix
+        keeps ``num_compiled_programs()`` counting both families."""
+        build = _make_fused_spec_decode(
+            self.model,
+            self._sampler,
+            eos_token=self.eos_token,
+            max_len=self.max_len,
+            decode_chunk=self.decode_chunk,
+            speculate=self.speculate,
+            ngram=self.spec_ngram,
+        )
+        return _cached_jit(
+            self.model,
+            "_serve_jit_cache",
+            (
+                "serve_decode_spec", self.decode_chunk, self.eos_token,
+                self.speculate, self.spec_ngram,
+            )
+            + self._static_key(),
+            build,
+            donate_argnums=(1,),  # kv slab: same aliasing as prefill
+            out_shardings=self._out_shardings(2),
+        )
+
+    def _spec_persistent_program(self):
+        """The persistent SPECULATIVE decode program
+        (``_make_persistent_spec_decode``): the spec body under the same
+        while-loop fixpoint drive, one ring row per ITERATION (worth up
+        to ``speculate + 1`` tokens) — drains still bound syncs."""
+        build = _make_persistent_spec_decode(
+            self.model,
+            self._sampler,
+            eos_token=self.eos_token,
+            max_len=self.max_len,
+            ring_capacity=self.ring_capacity,
+            speculate=self.speculate,
+            ngram=self.spec_ngram,
+        )
+        return _cached_jit(
+            self.model,
+            "_serve_jit_cache",
+            (
+                "serve_decode_persistent_spec", self.ring_capacity,
+                self.eos_token, self.speculate, self.spec_ngram,
+            )
+            + self._static_key(),
+            build,
+            donate_argnums=(1,),  # kv slab: same aliasing as prefill
+            out_shardings=self._out_shardings(3),
+        )
+
     # -- internals -------------------------------------------------------
 
     def _bucket_for(self, length: int) -> int:
@@ -1228,6 +1332,11 @@ class ServeEngine:
         self._seeds[slot] = req.seed
         self._ntok[slot] = 1
         self._budget[slot] = req.max_new_tokens
+        if self.speculate:
+            # seed the draft history with the prompt; generated tokens
+            # append at their stream index as the walks record them
+            self._hist[slot] = 0
+            self._hist[slot, : req.prompt.size] = req.prompt
         now = time.monotonic()
         self.metrics.count("prefill_calls")
         self.metrics.count("requests_admitted")
@@ -1254,6 +1363,12 @@ class ServeEngine:
         Perfetto request track) and the aggregates provably agree —
         pinned in tests/test_obs.py."""
         self._last_tok[req.slot] = tok
+        if self.speculate:
+            # the first token's stream index is the prompt length — the
+            # slot's cache position at record time (no advance has run)
+            p = int(self.cache.pos[req.slot])
+            if p < self.max_len:
+                self._hist[req.slot, p] = tok
         req.first_token_at = now
         req.record_event("first_token", ts=now)
         req.generated.append(tok)
@@ -1512,7 +1627,11 @@ class ServeEngine:
         own finish never exist on the host side, and the slot-steps the
         device masked out are accounted in ``masked_slot_steps``."""
         if self._persistent:
+            if self.speculate:
+                return self._spec_persistent_step(skip)
             return self._persistent_step(skip)
+        if self.speculate:
+            return self._spec_decode_step(skip)
         running = self.scheduler.running
         k_steps = self.decode_chunk
         program = self._decode_program()
@@ -1682,6 +1801,225 @@ class ServeEngine:
                     taken = j + 1
                     if self._check_finished(req, tok, now):
                         finished = True
+                        break
+            if finished:
+                # iterations the loop kept running past this slot's
+                # finish — the persistent analog of mid-chunk waste
+                self.metrics.count("masked_slot_steps", n_it - taken)
+            else:
+                any_cut = True  # ring filled before this request's end
+            ev = ("decode_chunk", now, {"tokens": taken})
+            if req.events and req.events[-1][0] == "finish":
+                # keep the lifecycle log causal (chunk, then finish)
+                req.events.insert(-1, ev)
+            else:
+                req.events.append(ev)
+        if any_cut:
+            self.metrics.count("ring_full_drains")
+        self.metrics.count("tokens_generated", emitted)
+        self.metrics.count("tokens_decoded", emitted)
+        if emitted:
+            self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+
+    def _consume_spec_block(
+        self, req: Request, ys_row, c: int, now: float
+    ) -> tuple:
+        """Consume ONE verified block (``c`` accepted tokens of a
+        ``(speculate + 1,)`` row) for one request: the same per-token
+        bookkeeping as the one-token walks, plus the draft-economy
+        counters and the history mirror.  The device truncation rule
+        guarantees any finish condition lands exactly on the block's
+        LAST emitted token (``generation._make_spec_decode_body``), so
+        the walk and the device's frozen carry agree token for token.
+        Returns ``(emitted, finished)``."""
+        K = self.speculate
+        # per live slot-iteration: K lanes drafted, c - 1 of them
+        # accepted, the rest of the K + 1 verify lanes spent on
+        # rejected (overwritten-before-visible) positions
+        self.metrics.count("draft_tokens_proposed", K)
+        self.metrics.count("draft_tokens_accepted", c - 1)
+        self.metrics.count("spec_rejected_lane_steps", (K + 1) - c)
+        slot = req.slot
+        emitted = 0
+        finished = False
+        for i in range(c):
+            tok = int(ys_row[i])
+            self._ntok[slot] += 1
+            self.cache.advance_slot(slot)
+            self._last_tok[slot] = tok
+            # post-advance, the slot's position IS the token's stream
+            # index — append it to the draft history at that row
+            p = int(self.cache.pos[slot])
+            if p < self.max_len:
+                self._hist[slot, p] = tok
+            req.generated.append(tok)
+            emitted += 1
+            if self._check_finished(req, tok, now):
+                finished = True
+                break
+        return emitted, finished
+
+    def _spec_decode_step(self, skip: Optional[Request] = None) -> None:
+        """The speculative sibling of ``_decode_step``: each of the
+        ``decode_chunk`` on-device iterations drafts, verifies and
+        accepts up to ``speculate + 1`` tokens per slot, still with ONE
+        host sync for the whole dispatch.  The walk consumes a VARIABLE
+        number of tokens per iteration per slot — ``cs[j, slot]`` is the
+        device's emitted count (0 exactly where the old valid/finished
+        mask was False), so host bookkeeping and device carries agree
+        iteration for iteration, token for token."""
+        running = self.scheduler.running
+        k_steps = self.decode_chunk
+        program = self._spec_decode_program()
+        args = [
+            self.params,
+            self.cache.kv,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self.cache.positions()),
+            jnp.asarray(self._hist),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+            jnp.asarray(self._ntok),
+            jnp.asarray(self._budget),
+            jnp.asarray(~self.cache.active),  # retired slots: finished
+        ]
+        if self.paged:
+            args.append(jnp.asarray(self.cache.page_tables))
+        name = f"serve/decode/spec{self.speculate}/k{k_steps}"
+        self._ensure_card(name, program, tuple(args))
+        with timed_annotation(
+            "serve/decode", self.metrics.decode_s.record
+        ) as timing, self._watch(name):
+            kv, ys, cs = program(*args)
+            self.cache.kv = kv  # before the sync: old slab was donated
+            # ONE host sync for the blocks and the counts together
+            ys, cs = jax.device_get((ys, cs))
+        self.metrics.count("host_syncs")
+        self.metrics.count("decode_dispatches")
+        self.metrics.count("decode_steps", k_steps)
+        self._record_tp_collectives(
+            self.num_slots * (self.speculate + 1), k_steps
+        )
+        now = time.monotonic()
+        emitted = 0
+        for req in running:
+            if req is skip or not self.cache.active[req.slot]:
+                # not yet cache-admitted (mid-chunked-prefill / a
+                # same-batch admit an interleaved dispatch ran ahead of)
+                continue
+            slot = req.slot
+            took = 0
+            for j in range(k_steps):
+                c = int(cs[j, slot])
+                if c == 0:
+                    break  # frozen from here on
+                n, finished = self._consume_spec_block(
+                    req, ys[j, slot], c, now
+                )
+                emitted += n
+                took = j + 1
+                if finished:
+                    # the device froze this slot for the rest of the
+                    # chunk; those iterations bought nothing
+                    self.metrics.count("masked_slot_steps", k_steps - 1 - j)
+                    break
+            ev = ("decode_chunk", now, {"tokens": took})
+            if req.events and req.events[-1][0] == "finish":
+                # keep the lifecycle log causal (chunk, then finish)
+                req.events.insert(-1, ev)
+            else:
+                req.events.append(ev)
+        self.metrics.count("tokens_generated", emitted)
+        self.metrics.count("tokens_decoded", emitted)
+        if emitted:
+            self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+
+    def _spec_persistent_step(self, skip: Optional[Request] = None) -> None:
+        """The speculative sibling of ``_persistent_step``: one
+        while-loop dispatch, one drain.  The ring holds one verified
+        block per ITERATION (up to ``speculate + 1`` tokens each) and
+        the count ring subsumes the old valid mask (``cnts[j, b] > 0``
+        exactly where it was True), so ``host_syncs == ring_drains``
+        exactly as before — speculation multiplies tokens per sync, it
+        never adds one."""
+        running = self.scheduler.running
+        program = self._spec_persistent_program()
+        toks = jnp.asarray(self._last_tok)
+        for slot, dev_tok in self._pending_first.items():
+            # freshly prefilled slots: splice the on-device first token
+            # into the loop's last-token row without a fetch (ARRAY-
+            # typed index: a python int would bake a per-slot scatter
+            # executable — see _persistent_step)
+            toks = toks.at[jnp.asarray(slot, jnp.int32)].set(dev_tok)
+        args = [
+            self.params,
+            self.cache.kv,
+            toks,
+            jnp.asarray(self.cache.positions()),
+            jnp.asarray(self._hist),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+            jnp.asarray(self._ntok),
+            jnp.asarray(self._budget),
+            # room check from the UNCLAMPED host positions, exactly as
+            # in _persistent_step
+            jnp.asarray(self.cache.active & (self.cache.pos < self.max_len)),
+        ]
+        if self.paged:
+            args.append(jnp.asarray(self.cache.page_tables))
+        name = (
+            f"serve/decode/persistent/spec{self.speculate}"
+            f"/r{self.ring_capacity}"
+        )
+        self._ensure_card(name, program, tuple(args))
+        with timed_annotation(
+            "serve/decode", self.metrics.decode_s.record
+        ) as timing, self._watch(name):
+            kv, ring, cnts, iters = program(*args)
+            self.cache.kv = kv  # before the sync: old slab was donated
+            # ONE host sync drains the block ring, the count ring, the
+            # cursor, and every pending first token together
+            block, cmat, n_it, firsts = jax.device_get(
+                (ring, cnts, iters, dict(self._pending_first))
+            )
+        n_it = int(n_it)
+        self._pending_first.clear()
+        self.metrics.count("host_syncs")  # the drain IS the sync
+        self.metrics.count("ring_drains")
+        self.metrics.count("decode_dispatches")
+        self.metrics.count("decode_steps", n_it)
+        self.metrics.count("loop_iterations", n_it)
+        self._record_tp_collectives(
+            self.num_slots * (self.speculate + 1), n_it
+        )
+        self.metrics.observe_ring(n_it)
+        now = time.monotonic()
+        emitted = 0
+        any_cut = False
+        for req in running:
+            if req is skip:
+                # mid-chunked-prefill request: parked, device-frozen
+                continue
+            slot = req.slot
+            taken = 0
+            finished = False
+            if slot in firsts:
+                tok = int(firsts[slot])
+                self._record_first(req, tok, now)
+                if self._check_finished(req, tok, now):
+                    # fin0 froze this slot before iteration 0
+                    finished = True
+            if not finished:
+                for j in range(n_it):
+                    c = int(cmat[j, slot])
+                    if c == 0:
+                        break  # frozen from here on: rows are rewrites
+                    n, finished = self._consume_spec_block(
+                        req, block[j, slot], c, now
+                    )
+                    emitted += n
+                    taken = j + 1
+                    if finished:
                         break
             if finished:
                 # iterations the loop kept running past this slot's

@@ -47,6 +47,22 @@ padding, scratch-page scribbles, stale rows of reused pages — is masked
 to exactly-zero probability and never perturbs a stream (regression:
 ``tests/test_prefix_cache.py`` reuses a retired request's pages and pins
 bit-identity against a fresh engine).
+
+Variable advance (speculative decode): with ``ServeEngine(speculate=K)``
+each verify call writes K+1 rows ``pos .. pos + K`` per slot
+(:func:`scatter_slot_tokens` / :func:`paged_scatter_tokens`) but ``pos``
+advances only by the TRACED accepted count ``e``.  The row-wise argument
+extends: rows ``pos .. pos + e - 1`` hold K/V of exactly the accepted
+token stream; rejected-lane rows ``pos + e .. pos + K`` sit beyond the
+new depth and are rewritten by the next verify before the visibility
+mask reaches them — overwrite-before-visible, the same invariant as the
+frozen-slot rewrites.  Rows that would land past ``max_len`` are DROPPED
+by the scatter (OOB index + ``mode="drop"``), never clamped: a clamped
+write would corrupt the slot's last row, and an unclamped flat index
+would alias into the NEXT slot's row 0 (slab) or an arbitrary pool row
+(paged).  In the paged layout the rejected/frozen overflow beyond a
+slot's allocated chain routes through its table to the scratch page,
+exactly like the frozen single-token writes.
 """
 
 from __future__ import annotations
@@ -67,6 +83,8 @@ __all__ = [
     "write_slot",
     "paged_view",
     "paged_scatter_rows",
+    "scatter_slot_tokens",
+    "paged_scatter_tokens",
 ]
 
 
@@ -136,6 +154,78 @@ def paged_scatter_rows(
         fv = v.reshape(-1, *v.shape[2:]).at[rows].set(seg_v.astype(v.dtype))
         out.append((fk.reshape(k.shape), fv.reshape(v.shape)))
     return out
+
+
+def scatter_slot_tokens(
+    cache: jax.Array, x_new: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Write ``S`` consecutive freshly computed rows per slot into the
+    contiguous slab at each slot's own depth: the multi-token decode
+    write (``ServeEngine(speculate=K)`` verifies ``S = K + 1`` candidate
+    positions per iteration).
+
+    ``cache``: (num_slots, max_len, H, D).  ``x_new``: (B, S, H, D).
+    ``positions``: (B,) int32 — slot ``b``'s rows land at
+    ``positions[b] + [0..S)``.  Rows past ``max_len`` are DROPPED via an
+    out-of-bounds flat index + ``mode="drop"`` — NOT clamped
+    (``dynamic_update_slice`` clamping would corrupt row ``max_len - 1``)
+    and NOT left to wrap (a flat ``b * max_len + row`` index past the
+    slot would alias into slot ``b + 1``'s row 0).  At ``S == 1`` and
+    in-range positions this is elementwise-identical to the vmapped
+    ``dynamic_update_slice`` write in ``slot_cached_attention``.
+    """
+    b, max_len = cache.shape[0], cache.shape[1]
+    s = x_new.shape[1]
+    rows = positions[:, None] + jnp.arange(s)[None, :]
+    flat_rows = jnp.where(
+        rows < max_len,
+        jnp.arange(b)[:, None] * max_len + rows,
+        b * max_len,  # out of bounds on purpose: dropped
+    )
+    flat = cache.reshape(b * max_len, *cache.shape[2:])
+    flat = flat.at[flat_rows.reshape(-1)].set(
+        x_new.astype(cache.dtype).reshape(b * s, *x_new.shape[2:]),
+        mode="drop",
+    )
+    return flat.reshape(cache.shape)
+
+
+def paged_scatter_tokens(
+    pool: jax.Array,
+    x_new: jax.Array,
+    page_tables: jax.Array,
+    positions: jax.Array,
+    page_size: int,
+) -> jax.Array:
+    """Paged sibling of :func:`scatter_slot_tokens`: route each of the
+    ``S`` per-slot rows through the slot's page table into the page
+    pool.
+
+    ``pool``: (num_pages, page_size, H, D).  ``x_new``: (B, S, H, D).
+    ``page_tables``: (B, pages_per_slot) int32.  ``positions``: (B,).
+    Logical rows past ``max_len`` are dropped (OOB + ``mode="drop"``);
+    rows inside ``max_len`` but past the slot's allocated chain follow
+    the table to the scratch page, exactly like the frozen single-token
+    writes (module docstring).
+    """
+    npages = pool.shape[0]
+    b, s = x_new.shape[0], x_new.shape[1]
+    pp = page_tables.shape[1]
+    offs = positions[:, None] + jnp.arange(s)[None, :]
+    page = jnp.take_along_axis(
+        page_tables, jnp.clip(offs // page_size, 0, pp - 1), axis=1
+    )
+    rows = jnp.where(
+        offs < pp * page_size,
+        page * page_size + offs % page_size,
+        npages * page_size,  # out of bounds on purpose: dropped
+    )
+    flat = pool.reshape(npages * page_size, *pool.shape[2:])
+    flat = flat.at[rows.reshape(-1)].set(
+        x_new.astype(pool.dtype).reshape(b * s, *x_new.shape[2:]),
+        mode="drop",
+    )
+    return flat.reshape(pool.shape)
 
 
 class _HostBookkeeping:

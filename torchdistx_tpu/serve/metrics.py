@@ -91,7 +91,17 @@ class ServeMetrics:
     token, THE number the fused decode loop exists to shrink),
     ``masked_slot_steps`` (slot-steps the on-device finish mask threw
     away because a request finished mid-chunk: the wasted-work side of
-    the host-sync tradeoff), the chunked-prefill set —
+    the host-sync tradeoff), the speculative-decoding set —
+    ``draft_tokens_proposed`` (n-gram draft tokens offered to the
+    verifier: ``speculate`` per live slot-iteration),
+    ``draft_tokens_accepted`` (drafts that matched the verified greedy
+    target and were emitted; ``accepted / proposed`` is the derived
+    ``accept_rate``) and ``spec_rejected_lane_steps`` (verify lanes
+    discarded by rejection — the speculative twin of
+    ``masked_slot_steps``; per live slot-iteration emitting ``e`` tokens
+    the identities are exact: ``accepted = e - 1``, ``rejected_lanes =
+    speculate + 1 - e``, so ``accepted + rejected_lanes = speculate``) —
+    the chunked-prefill set —
     ``chunked_prefills`` (long-prompt admissions split into chunks),
     ``prefill_chunks`` (chunk dispatches those admissions made) and
     ``prefill_interleaved_dispatches`` (decode dispatches interleaved
@@ -119,7 +129,8 @@ class ServeMetrics:
     allocated pages) and ``num_pages``; persistent engines add
     ``ring_capacity`` and ``ring_occupancy_hwm`` (high-water loop
     iterations a single dispatch used — at the capacity it means rings
-    are filling and requests span drains).
+    are filling and requests span drains); speculative engines add the
+    ``speculate`` config gauge (drafts per iteration, K).
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``tpot_s`` (per finished
     request: decode seconds per token after the first — the
@@ -154,12 +165,14 @@ class ServeMetrics:
         num_slots: int,
         num_pages: Optional[int] = None,
         ring_capacity: Optional[int] = None,
+        speculate: Optional[int] = None,
     ):
         self.num_slots = int(num_slots)
         self.num_pages = num_pages if num_pages is None else int(num_pages)
         self.ring_capacity = (
             ring_capacity if ring_capacity is None else int(ring_capacity)
         )
+        self.speculate = speculate if speculate is None else int(speculate)
         self.started_at = time.monotonic()
         self.counters: Dict[str, int] = {
             "requests_submitted": 0,
@@ -177,6 +190,9 @@ class ServeMetrics:
             "decode_dispatches": 0,
             "host_syncs": 0,
             "masked_slot_steps": 0,
+            "draft_tokens_proposed": 0,
+            "draft_tokens_accepted": 0,
+            "spec_rejected_lane_steps": 0,
             "loop_iterations": 0,
             "ring_drains": 0,
             "ring_full_drains": 0,
@@ -241,6 +257,8 @@ class ServeMetrics:
         if self.ring_capacity is not None:
             gauges["ring_capacity"] = self.ring_capacity
             gauges["ring_occupancy_hwm"] = self.ring_occupancy_hwm
+        if self.speculate is not None:
+            gauges["speculate"] = self.speculate
         wall = time.monotonic() - self.started_at
         # decode-only tokens over decode-only time: prefill's sampled
         # token rides a prefill dispatch, so counting it here would
@@ -248,6 +266,7 @@ class ServeMetrics:
         decode_time = self.decode_s.total
         tokens = self.counters["tokens_generated"]
         lookups = self.counters["prefix_lookup_tokens"]
+        proposed = self.counters["draft_tokens_proposed"]
         derived = {
             "wall_s": wall,
             "decode_tokens_per_sec": (
@@ -267,6 +286,25 @@ class ServeMetrics:
             "prefix_hit_rate": (
                 self.counters["prefix_hit_tokens"] / lookups
                 if lookups > 0
+                else None
+            ),
+            # the speculative-decode headlines: both EXACT ratios of
+            # deterministic counters (so the perf gate can pin them
+            # bit-identically), not timings.  proposed = speculate per
+            # live slot-iteration, so proposed / speculate is the live
+            # slot-iteration count and tokens-per-iteration is
+            # 1 + accepted / iterations.
+            "accept_rate": (
+                self.counters["draft_tokens_accepted"] / proposed
+                if proposed > 0
+                else None
+            ),
+            "accepted_tokens_per_iteration": (
+                1.0
+                + self.counters["draft_tokens_accepted"]
+                * self.speculate
+                / proposed
+                if proposed > 0 and self.speculate
                 else None
             ),
         }
