@@ -160,6 +160,16 @@ def _parse_args():
         "inter-token gap, chunked strictly below unchunked",
     )
     ap.add_argument(
+        "--migrate-tp-to",
+        type=int,
+        default=None,
+        metavar="N",
+        help="append an elastic-migration phase: drain a --tp engine "
+        "mid-decode and migrate_to() a tp=N engine, pinning zero drops, "
+        "bit-identical streams, and the closed-form migration wire bytes "
+        "as ledger counter rows (workload key 'mesh_to')",
+    )
+    ap.add_argument(
         "--artifact",
         default=None,
         help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
@@ -327,6 +337,16 @@ def _supervise(args) -> None:
                 },
             )
         )
+    if args.migrate_tp_to is not None:
+        plan.append(
+            (
+                "migrate",
+                {
+                    "TDX_SERVE_CHUNK": str(chunks[-1]),
+                    "TDX_SERVE_PHASE": "migrate",
+                },
+            )
+        )
 
     def emit():
         # the speculation A/B verdict, before the summary snapshots it:
@@ -394,12 +414,14 @@ def _supervise(args) -> None:
             continue
         cmd = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
         env = dict(os.environ, TDX_SERVE_CHILD="1", **phase_env)
-        if args.tp > 1 and env.get("TDX_BENCH_PLATFORM") == "cpu":
-            # the CPU smoke needs enough virtual devices for the mesh;
+        n_dev = max(args.tp, args.migrate_tp_to or 1)
+        if n_dev > 1 and env.get("TDX_BENCH_PLATFORM") == "cpu":
+            # the CPU smoke needs enough virtual devices for the mesh
+            # (the migrate phase may need MORE than --tp for its target);
             # the flag must be set before the child imports jax
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={args.tp}"
+                + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
         phase: dict = {}
         try:
@@ -533,11 +555,13 @@ def _phase_setup(args, **extra) -> tuple:
     return record, name, k_chunk, plat
 
 
-def _mesh_kwargs(args) -> dict:
+def _mesh_kwargs(args, tp: int = None) -> dict:
     """``ServeEngine(mesh=...)`` kwargs for the requested TP degree
-    (empty when --tp 1: the single-chip engine path stays the
-    reference)."""
-    if args.tp <= 1:
+    (empty when tp is 1: the single-chip engine path stays the
+    reference).  ``tp`` overrides ``args.tp`` — the migrate phase builds
+    its target engine on a different degree."""
+    tp = args.tp if tp is None else tp
+    if tp <= 1:
         return {}
     import numpy as np
 
@@ -545,11 +569,11 @@ def _mesh_kwargs(args) -> dict:
     from jax.sharding import Mesh
 
     devs = jax.devices()
-    if len(devs) < args.tp:
+    if len(devs) < tp:
         raise RuntimeError(
-            f"--tp {args.tp} needs {args.tp} devices, found {len(devs)}"
+            f"--tp {tp} needs {tp} devices, found {len(devs)}"
         )
-    return {"mesh": Mesh(np.asarray(devs[: args.tp]), ("tp",))}
+    return {"mesh": Mesh(np.asarray(devs[:tp]), ("tp",))}
 
 
 def _embed_cost(record: dict, engine) -> None:
@@ -1206,6 +1230,138 @@ def _child_chunked_prefill(args) -> None:
     print(json.dumps(record))
 
 
+def _child_migrate(args) -> None:
+    """The elastic-migration phase (ISSUE 12): a tp=``--tp`` engine is
+    drained mid-decode and ``migrate_to()``'d onto a tp=``--migrate-tp-to``
+    engine with a different slot count.  The phase flags ``error`` unless
+    every request completes (zero drops), the greedy token streams are
+    BIT-identical to an undrained run on the source shape, and the
+    migration's wire bytes match the ``parallel/reshard.py`` ring closed
+    form — the counters land as ledger rows under workload key
+    ``mesh_to`` so ``perf_gate.py --strict`` pins each shape pair."""
+    tp_to = int(args.migrate_tp_to)
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="migrate", mesh_to=tp_to
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.obs.comm import comm_audit
+    from torchdistx_tpu.serve import ServeEngine
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        bucket = 16
+        if max_len <= bucket:
+            raise ValueError(
+                f"max_len {max_len} leaves no decode room past the "
+                f"{bucket}-token prefill bucket"
+            )
+        max_new = min(args.max_new, max_len - bucket)
+        n_req = max(2, min(args.requests, args.slots + 2))
+        rs = np.random.RandomState(0)
+        prompts = [
+            rs.randint(0, 256, (int(rs.randint(5, bucket)),)).astype(np.int32)
+            for _ in range(n_req)
+        ]
+        work = [
+            dict(prompt=p, max_new_tokens=max_new, temperature=0.0)
+            for p in prompts
+        ]
+
+        def build(tp, slots):
+            return ServeEngine(
+                model,
+                num_slots=slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=(bucket,),
+                **_mesh_kwargs(args, tp=tp),
+            )
+
+        # undrained reference on the source shape: the bit-identity oracle
+        ref_tokens = [
+            r.tokens for r in build(args.tp, args.slots).run(work)
+        ]
+
+        src = build(args.tp, args.slots)
+        dst = build(tp_to, args.slots + 1)  # a DIFFERENT slot count
+        handles = [src.submit(**w) for w in work]
+        # decode just far enough that the drain suspends requests
+        # MID-stream (the KV handoff being pinned) — never to completion
+        for _ in range(max(1, (max_new - 1) // (2 * k_chunk))):
+            src.step()
+        t0 = time.monotonic()
+        src.drain()
+        with comm_audit() as prof:
+            summary = src.migrate_to(dst)
+        record["migrate_s"] = round(time.monotonic() - t0, 6)
+        while dst.step():
+            pass
+
+        results = [h.result() for h in handles]
+        streams_equal = all(
+            np.array_equal(r.tokens, ref)
+            for r, ref in zip(results, ref_tokens)
+        )
+        record["streams_identical"] = streams_equal
+        record["migrate_summary"] = summary
+        record["max_len"] = max_len
+        record["comm"] = prof.to_json()
+        # the ring closed form, computed independently of the engine:
+        # gather group g = tp_from / gcd(tp_from, tp_to), one all-gather
+        # per migrated slot row per layer per k/v array at unit*(g-1)/g
+        kv0 = src.cache.kv[0][0]
+        unit = int(np.prod(kv0.shape[1:])) * np.dtype(kv0.dtype).itemsize
+        g = max(1, args.tp // int(np.gcd(args.tp, tp_to)))
+        expect = (
+            summary["migrated_running"]
+            * len(src.cache.kv) * 2 * (unit * (g - 1) // g)
+            if g > 1
+            else 0
+        )
+        # the target finishes the streams, so its metrics are the phase
+        # metrics; graft the source-side migration counters in so ONE
+        # counter dict carries the whole pinned footprint
+        mb = dst.metrics.to_json()
+        for cname in ("migration_wire_bytes", "requests_migrated_out"):
+            mb["counters"][cname] = src.metrics.counters[cname]
+        mb["counters"]["migration_collectives"] = summary["collectives"]
+        record["metrics"] = mb
+        _embed_cost(record, dst)
+        if not streams_equal:
+            record["error"] = (
+                "migration changed a token stream — the handoff must be "
+                "value-exact"
+            )
+        elif summary["migrated_running"] < 1:
+            record["error"] = (
+                "nothing was suspended mid-stream — the workload finished "
+                "before drain(), so the phase pinned no KV handoff"
+            )
+        elif any(r.finish_reason != "length" for r in results):
+            record["error"] = (
+                "a migrated request was dropped or cut short: "
+                f"{[r.finish_reason for r in results]}"
+            )
+        elif summary["wire_bytes"] != expect:
+            record["error"] = (
+                f"migration wire bytes {summary['wire_bytes']} != ring "
+                f"closed form {expect} (tp {args.tp}->{tp_to}, g={g})"
+            )
+        elif int(prof.wire_bytes()) != summary["wire_bytes"]:
+            record["error"] = (
+                f"comm audit wire {int(prof.wire_bytes())} disagrees with "
+                f"the migration summary {summary['wire_bytes']}"
+            )
+        _dump_obs(record, dst, "migrate")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def main() -> None:
     args = _parse_args()
     if os.environ.get("TDX_SERVE_CHILD") == "1":
@@ -1216,6 +1372,8 @@ def main() -> None:
             _child_chunked_prefill(args)
         elif phase == "speculate":
             _child_spec(args)
+        elif phase == "migrate":
+            _child_migrate(args)
         else:
             _child(args)
     else:

@@ -12,6 +12,11 @@ NaN, and lets the failure policy roll back.  The gate then demands:
   kill -9 channel) also exists and validates — the evidence a hard kill
   would have left.
 
+A second leg (ISSUE 12) injects a ``device_loss`` under
+``on_failure="reshard"`` and demands the dump tail show the elastic
+recovery: ``failure`` -> ``reshard_start`` -> ``reshard_done`` (naming
+both mesh shapes) -> ``rollback``.
+
 Exit nonzero with a reason when any artifact is missing — a crash that
 leaves no black box is THE regression this smoke exists to catch.
 
@@ -65,9 +70,9 @@ class _MLP(nn.Module):
         return self.fc2(jax.nn.relu(self.fc1(x)))
 
 
-def main() -> None:
+def _build_trainer(seed: int, on_failure: str):
     mesh = create_mesh({"fsdp": 8})
-    tdx.manual_seed(0)
+    tdx.manual_seed(seed)
     model = tdx.deferred_init(_MLP)
     tdx.materialize_module(model)
     params = dict(model.named_parameters())
@@ -80,16 +85,76 @@ def main() -> None:
     params = step.shard_params(params)
     opt_state = step.init_optimizer(params)
 
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(seed)
     batches = [(b, b) for b in (rs.randn(8, 16).astype(np.float32)
                                 for _ in range(8))]
+    detector = FailureDetector(nan_tolerance=0)
     trainer = Trainer(
         step, params, opt_state,
         checkpoint_dir=tempfile.mkdtemp(prefix="crash_smoke_ck_"),
         checkpoint_every=2, log_every=1, log_fn=lambda m: None,
-        failure_detector=FailureDetector(nan_tolerance=0),
-        on_failure="restore",
+        failure_detector=detector,
+        on_failure=on_failure,
     )
+    return trainer, detector, batches
+
+
+def _device_loss_leg(errors: list) -> None:
+    """ISSUE 12: a handled ``device_loss`` must leave a schema-valid dump
+    whose tail shows the elastic reshard — ``failure`` (kind
+    ``device_loss``) then ``reshard_start``/``reshard_done`` naming both
+    mesh shapes, then the ``rollback`` bookkeeping entry."""
+    trainer, detector, batches = _build_trainer(1, on_failure="reshard")
+    trainer.fit(batches[:4])
+    detector.inject_device_loss(4)
+    res = trainer.fit(batches[4:])
+
+    dump = trainer.last_flight_dump
+    if not dump:
+        errors.append("device_loss fit() produced NO flight dump")
+        return
+    check_flight(dump, errors, expect_rollback=True)
+    with open(dump) as f:
+        records = [json.loads(ln) for ln in f.read().splitlines() if ln.strip()]
+    # the flight ring is process-global: earlier legs' records share the
+    # dump — anchor on THIS leg's device_loss failure, not the first one
+    i_fail = next(
+        (i for i, r in enumerate(records)
+         if r.get("kind") == "failure"
+         and r.get("failure_kind") == "device_loss"),
+        None,
+    )
+    if i_fail is None:
+        errors.append(f"device_loss dump {dump}: no device_loss failure record")
+        return
+    tail_kinds = [r.get("kind") for r in records[i_fail:]]
+    for want in ("reshard_start", "reshard_done", "rollback"):
+        if want not in tail_kinds:
+            errors.append(
+                f"device_loss dump {dump}: no {want!r} record after the "
+                f"device_loss failure"
+            )
+            return
+    if not (
+        tail_kinds.index("reshard_start")
+        < tail_kinds.index("reshard_done")
+        < tail_kinds.index("rollback")
+    ):
+        errors.append(f"device_loss dump: out-of-order tail {tail_kinds}")
+    done = records[i_fail + tail_kinds.index("reshard_done")]
+    if done.get("mesh_from") != {"fsdp": 8} or done.get("mesh_to") != {"fsdp": 4}:
+        errors.append(
+            f"device_loss dump: reshard_done names "
+            f"{done.get('mesh_from')} -> {done.get('mesh_to')}, "
+            f"want fsdp 8 -> 4"
+        )
+    if not np.isfinite(res["loss"]):
+        errors.append(f"post-reshard run not recovered: {res}")
+    print(f"device-loss dump {dump}: {len(records)} records, reshard OK")
+
+
+def main() -> None:
+    trainer, _, batches = _build_trainer(0, on_failure="restore")
     trainer.fit(batches[:4])
 
     poisoned = dict(trainer.params)
@@ -118,6 +183,8 @@ def main() -> None:
 
     if not np.isfinite(res["loss"]):
         errors.append(f"rollback did not recover the run: {res}")
+
+    _device_loss_leg(errors)
 
     if errors:
         for e in errors:

@@ -131,6 +131,42 @@ def test_slowmo_state_dict_checkpoint(tmp_path):
     )
 
 
+def test_restore_single_sharding_broadcasts_to_every_leaf(tmp_path, mesh8):
+    """shardings= accepts a single Sharding (not a pytree): every leaf of
+    the checkpoint restores into that placement — the shorthand the
+    elastic reshard-via-checkpoint bounce leans on."""
+    state = {
+        "layer": {"w": jnp.arange(64.0).reshape(8, 8)},
+        "b": jnp.arange(8.0),
+    }
+    save_checkpoint(str(tmp_path / "ck"), state)
+    sh = NamedSharding(mesh8, P("fsdp"))
+    out = restore_checkpoint(str(tmp_path / "ck"), shardings=sh)
+    for leaf in jax.tree_util.tree_leaves(out):
+        assert leaf.sharding.is_equivalent_to(sh, leaf.ndim)
+    np.testing.assert_array_equal(
+        np.asarray(out["layer"]["w"]), np.asarray(state["layer"]["w"])
+    )
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(state["b"]))
+
+
+def test_restore_like_casts_dtype_on_sharded_state(tmp_path, mesh8):
+    """like= dtype casting composes with shardings=: an fp32 checkpoint
+    restores straight into an FSDP placement AND casts to the bf16
+    template without losing the placement."""
+    state = {"w": jnp.arange(64.0).reshape(8, 8)}
+    save_checkpoint(str(tmp_path / "ck"), state)
+    sh = NamedSharding(mesh8, P("fsdp"))
+    like = {"w": jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)}
+    out = restore_checkpoint(str(tmp_path / "ck"), shardings={"w": sh}, like=like)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["w"].sharding.is_equivalent_to(sh, 2)
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]),
+        np.asarray(state["w"]).astype(jnp.bfloat16),
+    )
+
+
 def test_streaming_restore_into_template_shardings(tmp_path, mesh8):
     """shardings_from=: every restored array streams directly into the
     template leaf's sharding (the sharded map_location, without a

@@ -1,0 +1,349 @@
+"""Elastic resharding (ISSUE 12): on-mesh pytree redistribution with
+closed-form wire accounting, and the Trainer's device-loss recovery.
+
+Pinned invariants:
+
+- **Redistribution model** (parallel/reshard.py, arXiv:2112.01075): an
+  8-way-sharded leaf unsharding to replicated books a ring all-gather of
+  ``7S/8`` wire bytes; a same-layout move books zero; 8-way -> 4-way
+  books ``S/2`` (gather group ``g = 2``).  The booked profile matches
+  ``reshard_wire_bytes``'s closed form exactly.
+- **Survivability** (``can_reshard_live``): replicated leaves survive
+  any shrink; an 8-way-sharded leaf does NOT survive onto 4 devices —
+  the checkpoint-bounce path is mandatory there.
+- **Trainer elasticity**: an injected ``device_loss`` under
+  ``on_failure="reshard"`` shrinks the mesh 8 -> 4 and continues with a
+  loss stream and final parameters BIT-IDENTICAL to a fresh 4-device
+  run transplanted from the recovery step — for BOTH the live path
+  (a dp replica dies, survivors hold a full copy) and the
+  checkpoint-bounce path (fsdp shards lived on the lost devices).
+  Migration wire bytes land in the trainer's comm profile as the exact
+  ring-model numbers, and the flight recorder shows
+  ``reshard_start``/``reshard_done`` naming both mesh shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.nn import functional_call
+from torchdistx_tpu.obs.comm import CommProfile, comm_audit
+from torchdistx_tpu.parallel import (
+    ShardedTrainStep,
+    can_reshard_live,
+    create_mesh,
+    optimizer_state_shardings,
+    plan_reshard,
+    reshard,
+    reshard_via_checkpoint,
+    reshard_wire_bytes,
+)
+from torchdistx_tpu.trainer import Trainer
+from torchdistx_tpu.utils.failure import FailureDetector, StepFailure
+
+F32 = 4
+
+
+def _mesh(n, axis="fsdp"):
+    return Mesh(np.asarray(jax.devices()[:n]), (axis,))
+
+
+def _sharded(mesh, shape, spec):
+    x = jnp.arange(int(np.prod(shape)), dtype=jnp.float32).reshape(shape)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+class TestReshardPlan:
+    def test_unshard_8_way_books_seven_eighths(self):
+        m8 = _mesh(8)
+        x = _sharded(m8, (64, 16), P("fsdp"))
+        S = 64 * 16 * F32
+        repl = NamedSharding(m8, P())
+        plan = plan_reshard({"x": x}, repl)
+        assert len(plan) == 1
+        assert plan[0]["gather_group"] == 8
+        assert plan[0]["wire_bytes"] == S * 7 // 8
+        assert reshard_wire_bytes({"x": x}, repl) == S * 7 // 8
+
+    def test_same_layout_books_zero(self):
+        m8 = _mesh(8)
+        x = _sharded(m8, (64, 16), P("fsdp"))
+        assert plan_reshard({"x": x}, {"x": x.sharding}) == []
+        # replicated source: every device already holds everything
+        r = _sharded(m8, (64, 16), P())
+        assert reshard_wire_bytes({"r": r}, NamedSharding(m8, P("fsdp"))) == 0
+
+    def test_8_to_4_books_half(self):
+        m8, m4 = _mesh(8), _mesh(4)
+        x = _sharded(m8, (64, 16), P("fsdp"))
+        S = 64 * 16 * F32
+        tgt = NamedSharding(m4, P("fsdp"))
+        plan = plan_reshard([x], [tgt])
+        assert plan[0]["gather_group"] == 2  # gcd(8, 4) = 4 preserved
+        assert plan[0]["wire_bytes"] == S // 2
+
+    def test_reshard_books_into_audit_and_moves(self):
+        m8, m4 = _mesh(8), _mesh(4)
+        x = _sharded(m8, (64, 16), P("fsdp"))
+        tgt = NamedSharding(m4, P("fsdp"))
+        prof = CommProfile()
+        with comm_audit(prof):
+            out = reshard({"x": x}, {"x": tgt})
+        assert out["x"].sharding == tgt
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
+        S = 64 * 16 * F32
+        assert int(prof.wire_bytes("all_gather", "fsdp")) == S // 2
+        assert int(prof.payload_bytes("all_gather", "fsdp")) == S
+        assert prof.ops("all_gather") == 1
+
+    def test_leaf_count_mismatch_raises(self):
+        m8 = _mesh(8)
+        x = _sharded(m8, (8, 8), P())
+        with pytest.raises(ValueError, match="leaves"):
+            plan_reshard({"a": x, "b": x}, {"a": x.sharding})
+
+    def test_can_reshard_live(self):
+        m8, m4 = _mesh(8), _mesh(4)
+        sharded8 = _sharded(m8, (64, 16), P("fsdp"))
+        repl8 = _sharded(m8, (64, 16), P())
+        # 8-way shards: half of them ONLY exist on the lost devices
+        assert not can_reshard_live({"w": sharded8}, m4)
+        # replicated: any survivor holds a full copy
+        assert can_reshard_live({"w": repl8}, m4)
+        assert can_reshard_live({"w": sharded8}, m8)
+
+    def test_bounce_books_broadcast(self, tmp_path):
+        m8, m4 = _mesh(8), _mesh(4)
+        x = _sharded(m8, (64, 16), P("fsdp"))
+        tgt = NamedSharding(m4, P("fsdp"))
+        prof = CommProfile()
+        with comm_audit(prof):
+            out = reshard_via_checkpoint(
+                {"x": x}, str(tmp_path / "bounce"), {"x": tgt}
+            )
+        assert out["x"].sharding == tgt
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
+        S = 64 * 16 * F32
+        # host-to-mesh fan-out: ring broadcast over the 4 target devices
+        assert int(prof.wire_bytes("broadcast", "fsdp")) == S * 3 // 4
+
+
+# -- Trainer elasticity ---------------------------------------------------
+
+
+class MLP(nn.Module):
+    def __init__(self, d=16, h=64):
+        super().__init__()
+        self.fc1 = nn.Linear(d, h)
+        self.fc2 = nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+def _materialized_mlp():
+    tdx.manual_seed(0)
+    m = tdx.deferred_init(MLP)
+    tdx.materialize_module(m)
+    return m
+
+
+def _step(model, mesh, **kw):
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((functional_call(model, p, (x,)) - y) ** 2)
+
+    return ShardedTrainStep(loss_fn, optax.adam(1e-2), mesh, **kw)
+
+
+def _batches(n):
+    rs = np.random.RandomState(0)
+    return [
+        (b, b)
+        for b in (rs.randn(8, 16).astype(np.float32) for _ in range(n))
+    ]
+
+
+def _trainer(step, params, opt, tmp_path, logs, det=None, flight=None):
+    return Trainer(
+        step,
+        params,
+        opt,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=100,  # never: reshard must move LIVE state
+        log_every=1,
+        log_fn=logs.append,
+        failure_detector=det,
+        on_failure="reshard",
+        flight=flight,
+    )
+
+
+def _transplant_reference(model, mesh_small, params, opt, batches, tmp_path):
+    """The acceptance oracle: place the recovery-step state onto a fresh
+    small-mesh step and train it forward — the elastic run must match
+    this bitwise."""
+    from torchdistx_tpu.obs.flight import FlightRecorder
+
+    step = _step(model, mesh_small, shard_axis="fsdp")
+    p = jax.device_put(params, step.param_sharding(params))
+    o = jax.device_put(
+        opt, optimizer_state_shardings(opt, p, mesh_small)
+    )
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    tr = Trainer(
+        step, p, o,
+        checkpoint_dir=str(tmp_path / "ref_ck"), checkpoint_every=100,
+        log_every=1, log_fn=lambda m: None, flight=rec,
+    )
+    tr.fit(batches)
+    return tr, rec
+
+
+class TestTrainerElastic:
+    def test_bounce_8_to_4_bit_consistent(self, tmp_path):
+        """fsdp=8 shards die with the lost devices -> checkpoint bounce;
+        the continued loss stream and final params match a fresh
+        4-device run from the recovery step bitwise."""
+        from torchdistx_tpu.obs.flight import FlightRecorder
+
+        batches = _batches(10)
+        mesh8 = create_mesh({"fsdp": 8})
+        model = _materialized_mlp()
+        step = _step(model, mesh8, shard_axis="fsdp")
+        params = step.shard_params(dict(model.named_parameters()))
+        # host snapshot of the init: the jitted step donates its param
+        # buffers, so the oracle replay needs its own copies
+        init_np = jax.tree_util.tree_map(np.asarray, params)
+        opt = step.init_optimizer(params)
+        det = FailureDetector()
+        logs = []
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        tr = _trainer(step, params, opt, tmp_path, logs, det, flight=rec)
+        tr.fit(batches[:5])
+        det.inject_device_loss(4)
+        tr.fit(batches[5:])
+
+        fails = [m for m in logs if "failure" in m]
+        assert fails and fails[0]["action"] == "resharded"
+        assert fails[0]["failure"] == "device_loss"
+        assert dict(tr.step.mesh.shape) == {"fsdp": 4}
+        for leaf in jax.tree_util.tree_leaves(tr.params):
+            assert len(leaf.sharding.device_set) == 4
+        assert tr._t_reshard > 0.0
+
+        # flight shows the migration with both mesh shapes
+        events = [
+            r for r in rec.records() if r["kind"].startswith("reshard")
+        ]
+        assert [e["kind"] for e in events] == [
+            "reshard_start", "reshard_done",
+        ]
+        assert events[0]["mesh_from"] == {"fsdp": 8}
+        assert events[0]["mesh_to"] == {"fsdp": 4}
+        done = events[1]
+        assert done["mode"] == "checkpoint"
+
+        # exact ring-model wire bytes: one broadcast per leaf onto the
+        # 4 surviving devices
+        nbytes = sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for t in (tr.params, tr.opt_state)
+            for l in jax.tree_util.tree_leaves(t)
+        )
+        assert done["wire_bytes"] == nbytes * 3 // 4
+        assert int(tr.comm_profile.wire_bytes("broadcast")) == (
+            nbytes * 3 // 4
+        )
+
+        # bit-consistent continuation vs the transplant oracle: the
+        # failing window's step RAN before the boundary check raised,
+        # so recovery happens from the post-step-6 state — replay a
+        # clean 8-mesh run to that step (deterministic: same init, same
+        # batches), then transplant onto a fresh 4-device mesh
+        rec_step = events[0]["step"]
+        assert rec_step == 6
+        ref8 = Trainer(
+            step,
+            step.shard_params(init_np),
+            log_every=1, log_fn=lambda m: None,
+        )
+        ref8.fit(batches[:rec_step])
+        mesh4 = _mesh(4)
+        ref_tr, ref_rec = _transplant_reference(
+            model, mesh4, ref8.params, ref8.opt_state,
+            batches[rec_step:], tmp_path,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(tr.params),
+            jax.tree_util.tree_leaves(ref_tr.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the post-reshard LOSS STREAM matches bitwise too (flight step
+        # records carry the unrounded loss; the oracle's first boundary
+        # is consumed by its warmup-window reset, so compare the
+        # overlapping tail)
+        elastic_losses = [
+            r["loss"] for r in rec.records()
+            if r["kind"] == "step" and r["step"] > rec_step
+        ]
+        ref_losses = [
+            r["loss"] for r in ref_rec.records() if r["kind"] == "step"
+        ]
+        assert len(ref_losses) >= 2
+        assert elastic_losses[-len(ref_losses):] == ref_losses
+
+    def test_live_dp_shrink_zero_wire_bit_consistent(self, tmp_path):
+        """A dp replica dies but the surviving fsdp=4 group holds a full
+        copy -> live redistribution, zero wire bytes, bit-consistent
+        continuation."""
+        batches = _batches(8)
+        devs = np.asarray(jax.devices())
+        mesh_big = Mesh(devs.reshape(2, 4), ("dp", "fsdp"))
+        mesh_small = Mesh(devs[:4].reshape(1, 4), ("dp", "fsdp"))
+        model = _materialized_mlp()
+        step = _step(model, mesh_big, shard_axis="fsdp")
+        params = step.shard_params(dict(model.named_parameters()))
+        opt = step.init_optimizer(params)
+        logs = []
+        tr = _trainer(step, params, opt, tmp_path, logs)
+        tr.fit(batches[:4])
+        p4 = jax.tree_util.tree_map(np.asarray, tr.params)
+        o4 = jax.tree_util.tree_map(np.asarray, tr.opt_state)
+
+        prof = CommProfile()
+        with comm_audit(prof):
+            mode = tr.reshard(mesh=mesh_small)
+        assert mode == "live"
+        # fsdp layout preserved on the survivors: g == 1 everywhere
+        assert prof.ops() == 0 and int(prof.wire_bytes()) == 0
+        for leaf in jax.tree_util.tree_leaves(tr.params):
+            assert len(leaf.sharding.device_set) == 4
+        tr.fit(batches[4:])
+
+        ref_tr, _ = _transplant_reference(
+            model, mesh_small,
+            jax.tree_util.tree_map(jnp.asarray, p4),
+            jax.tree_util.tree_map(jnp.asarray, o4),
+            batches[4:], tmp_path,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(tr.params),
+            jax.tree_util.tree_leaves(ref_tr.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_shrunk_mesh_shapes(self):
+        devs = np.asarray(jax.devices())
+        m = Mesh(devs.reshape(2, 4), ("dp", "fsdp"))
+        small = Trainer._shrunk_mesh(m, 4)
+        assert dict(small.shape) == {"dp": 1, "fsdp": 4}
+        m1 = Mesh(devs, ("fsdp",))
+        assert dict(Trainer._shrunk_mesh(m1, 4).shape) == {"fsdp": 4}
+        with pytest.raises(StepFailure):
+            Trainer._shrunk_mesh(m1, 3)  # 5 survivors divide nothing

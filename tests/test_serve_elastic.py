@@ -1,0 +1,280 @@
+"""Serve-side elasticity (ISSUE 12): ``drain()`` + ``migrate_to()``.
+
+The pinned invariants, on the 8-device CPU mesh:
+
+- **Drain is a named refusal, not a silent stall**: a draining engine
+  raises on ``submit()`` with the reason in the message, and the queued
+  FCFS head gets a ``("gated", {"why": "draining"})`` lifecycle event.
+  The drain gate runs BEFORE the hbm/page gates, so draining reserves
+  nothing a migration would have to unwind.
+- **Zero drops, bit-identical streams**: migrating a live engine —
+  suspended mid-stream slots WITH their KV state, plus the whole queue
+  — onto a differently shaped engine (tp=2 -> tp=1, different slot
+  count) completes every request with greedy token streams
+  BIT-identical to an undrained run on the source shape.  Outstanding
+  ``RequestHandle``s stay valid (requests move rid-intact).
+- **Exact migration wire accounting**: the KV handoff books ring
+  all-gathers per the ``parallel/reshard.py`` closed form — tp=2
+  head-sharded cache to tp=1 replicated is gather group ``g = 2``,
+  wire = ``S/2`` per moved row/page per layer per k/v array; a
+  same-shape migration books ZERO.  ``migrate_to``'s summary, the comm
+  audit, and the ``migration_wire_bytes`` counter all agree.
+- **Atomic validation**: shape/capacity mismatches fail BEFORE any
+  state moves — both engines are untouched afterwards.
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import jax
+import torchdistx_tpu as tdx
+from torchdistx_tpu.models import Llama
+from torchdistx_tpu.obs.comm import CommProfile, comm_audit
+from torchdistx_tpu.serve import ServeEngine
+
+
+def _llama():
+    tdx.manual_seed(0)
+    return Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+
+
+def _prompts(seed, lengths):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 256, (n,)).astype(np.int32) for n in lengths]
+
+
+def _tp_mesh(tp):
+    return Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+
+
+def _engine(tp, slots, paged=False, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("decode_chunk", 2)
+    if paged:
+        kw.setdefault("page_size", 8)
+        kw.setdefault("num_pages", 32)
+    if tp > 1:
+        kw["mesh"] = _tp_mesh(tp)
+    return ServeEngine(_llama(), num_slots=slots, **kw)
+
+
+def _kv_unit_bytes(engine, paged):
+    """Bytes of one slot row (slab) or one page (paged) of one k/v
+    array — dims [1:] of the cache geometry."""
+    arr = engine.cache.kv[0][0]
+    return int(np.prod(arr.shape[1:])) * np.dtype(arr.dtype).itemsize
+
+
+class TestDrain:
+    def test_submit_refused_with_named_reason(self):
+        eng = _engine(1, 2)
+        eng.drain()
+        with pytest.raises(RuntimeError, match="draining"):
+            eng.submit(_prompts(0, (5,))[0], max_new_tokens=2)
+        assert eng.metrics.counters["submits_rejected_draining"] == 1
+
+    def test_queued_head_gets_draining_gate_event(self):
+        eng = _engine(1, 1)
+        p = _prompts(1, (5, 6))
+        h0 = eng.submit(p[0], max_new_tokens=4)
+        h1 = eng.submit(p[1], max_new_tokens=4)
+        eng.step()  # admits p0; p1 queued behind the single slot
+        left = eng.drain()
+        assert left == 2
+        head = eng.scheduler.queued[0]
+        gated = [e for e in head.events if e[0] == "gated"]
+        assert gated and gated[-1][2]["why"] == "draining"
+        # steps during drain admit nothing but keep decoding
+        eng.step()
+        assert eng.scheduler.queue_depth == 1
+        assert gated[-1][2]["why"] == "draining"
+        del h0, h1
+
+    def test_drain_wins_over_page_gate_and_reserves_nothing(self):
+        # pool sized so the queued head is PAGE-gated pre-drain; after
+        # drain() the named cause flips to "draining" and no pages are
+        # reserved by later steps
+        eng = _engine(1, 2, paged=True, num_pages=5)  # 4 allocatable
+        p = _prompts(2, (8, 8))
+        eng.submit(p[0], max_new_tokens=8)  # 2 pages
+        eng.submit(p[1], max_new_tokens=8)
+        eng.step()  # admits p0 (2 pages); p1 blocked: needs 2, 2 free?
+        # force the page squeeze regardless of rounding: fill the pool
+        in_use_before = eng.pool.in_use
+        eng.drain()
+        eng.step()
+        assert eng.pool.in_use == in_use_before  # drain reserved nothing
+        head = eng.scheduler.queued
+        if head:  # p1 still queued: its latest gate cause is the drain
+            gated = [e for e in head[0].events if e[0] == "gated"]
+            assert gated[-1][2]["why"] == "draining"
+
+    def test_drain_complete_finishes_running_keeps_queued(self):
+        eng = _engine(1, 1)
+        p = _prompts(3, (5, 6))
+        h0 = eng.submit(p[0], max_new_tokens=3)
+        h1 = eng.submit(p[1], max_new_tokens=3)
+        eng.step()
+        left = eng.drain(complete=True)
+        assert left == 1  # the queued request survives, un-admitted
+        assert h0.done() and not h1.done()
+        assert h0.result().finish_reason == "length"
+
+
+class TestMigrate:
+    def _run_elastic(self, paged, tp_from=2, tp_to=1, slots_from=3,
+                     slots_to=4, steps_before=2):
+        """Shared scenario: reference run on the source shape, then an
+        elastic run suspended mid-stream and migrated.  Returns
+        (handles, ref_tokens, summary, prof, src, dst)."""
+        prompts = _prompts(7, (6, 9, 5, 11))
+        mnt = [8, 10, 12, 6]
+        ref = _engine(tp_from, slots_from, paged).run(
+            [dict(prompt=p, max_new_tokens=m)
+             for p, m in zip(prompts, mnt)]
+        )
+        ref_tokens = [r.tokens for r in ref]
+
+        src = _engine(tp_from, slots_from, paged)
+        dst = _engine(tp_to, slots_to, paged)
+        handles = [
+            src.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, mnt)
+        ]
+        for _ in range(steps_before):
+            src.step()
+        src.drain()
+        prof = CommProfile()
+        with comm_audit(prof):
+            summary = src.migrate_to(dst)
+        while dst.step():
+            pass
+        return handles, ref_tokens, summary, prof, src, dst
+
+    def test_tp2_to_tp1_bit_identical_zero_drops(self):
+        """The acceptance pin: tp=2 -> tp=1 with a different slot
+        count, in-flight requests suspended mid-stream, every stream
+        completes bit-identically, nothing dropped, wire bytes exact."""
+        handles, ref_tokens, summary, prof, src, dst = self._run_elastic(
+            paged=False
+        )
+        assert summary["migrated_running"] == 3
+        assert summary["migrated_queued"] == 1
+        assert (summary["tp_from"], summary["tp_to"]) == (2, 1)
+        assert (summary["slots_from"], summary["slots_to"]) == (3, 4)
+        # zero drops: every handle resolves, streams bit-identical
+        for h, ref in zip(handles, ref_tokens):
+            assert h.done()
+            np.testing.assert_array_equal(h.result().tokens, ref)
+        assert all(
+            h.result().finish_reason == "length" for h in handles
+        )
+        # closed form: head axis tp=2 -> replicated is g=2; one gather
+        # per migrated row per layer per k/v array at unit/2 wire
+        unit = _kv_unit_bytes(src, paged=False)
+        n_layers = len(src.cache.kv)
+        expect = 3 * n_layers * 2 * (unit // 2)
+        assert summary["wire_bytes"] == expect
+        assert int(prof.wire_bytes("all_gather", "tp")) == expect
+        assert src.metrics.counters["migration_wire_bytes"] == expect
+        assert src.metrics.counters["requests_migrated_out"] == 4
+        assert dst.metrics.counters["requests_migrated_in"] == 4
+        # the source is empty (and still refuses submissions)
+        assert not src.scheduler.has_work()
+        with pytest.raises(RuntimeError, match="draining"):
+            src.submit(np.ones(4, np.int32), max_new_tokens=1)
+
+    def test_same_shape_migration_books_zero_wire(self):
+        handles, ref_tokens, summary, prof, _, _ = self._run_elastic(
+            paged=False, tp_from=1, tp_to=1, slots_from=2, slots_to=3
+        )
+        assert summary["wire_bytes"] == 0 == int(prof.wire_bytes())
+        assert summary["collectives"] == 0
+        for h, ref in zip(handles, ref_tokens):
+            np.testing.assert_array_equal(h.result().tokens, ref)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("paged", [False, True])
+    @pytest.mark.parametrize(
+        "tp_from,tp_to,slots_from,slots_to",
+        [(2, 1, 3, 4), (1, 2, 3, 3), (2, 2, 2, 4)],
+    )
+    def test_migration_grid(self, paged, tp_from, tp_to, slots_from,
+                            slots_to):
+        """The -m slow grid: tp up/down/same x slab/paged x slot count
+        up/down, all bit-identical with exact wire accounting."""
+        handles, ref_tokens, summary, prof, src, _ = self._run_elastic(
+            paged, tp_from, tp_to, slots_from, slots_to,
+            steps_before=3,
+        )
+        for h, ref in zip(handles, ref_tokens):
+            np.testing.assert_array_equal(h.result().tokens, ref)
+        unit = _kv_unit_bytes(src, paged)
+        n_layers = len(src.cache.kv)
+        # the gather group is set by the SOURCE's head-axis split vs what
+        # the target layout preserves: g = tp_from / gcd(tp_from, tp_to)
+        g = max(1, tp_from // int(np.gcd(tp_from, tp_to)))
+        n_units = (
+            summary["pages_moved"] if paged else summary["migrated_running"]
+        )
+        expect = (
+            n_units * n_layers * 2 * (unit * (g - 1) // g) if g > 1 else 0
+        )
+        assert summary["wire_bytes"] == expect
+        assert int(prof.wire_bytes()) == expect
+
+    def test_paged_migration_fast_pin(self):
+        handles, ref_tokens, summary, prof, src, dst = self._run_elastic(
+            paged=True
+        )
+        for h, ref in zip(handles, ref_tokens):
+            np.testing.assert_array_equal(h.result().tokens, ref)
+        # page chains were re-homed: target table rows point at freshly
+        # allocated target pages, source pool fully released
+        assert src.pool.in_use == 0
+        unit = _kv_unit_bytes(src, paged=True)
+        n_layers = len(src.cache.kv)
+        assert summary["pages_moved"] > 0
+        assert summary["wire_bytes"] == (
+            summary["pages_moved"] * n_layers * 2 * (unit // 2)
+        )
+        assert int(prof.wire_bytes("all_gather", "tp")) == (
+            summary["wire_bytes"]
+        )
+
+
+class TestMigrateValidation:
+    def test_rejects_self_and_shape_mismatches(self):
+        a = _engine(1, 2)
+        with pytest.raises(ValueError, match="itself"):
+            a.migrate_to(a)
+        b_paged = _engine(1, 2, paged=True)
+        with pytest.raises(RuntimeError, match="slab and paged"):
+            a.migrate_to(b_paged)
+        c = _engine(1, 2, max_len=32)
+        with pytest.raises(RuntimeError, match="max_len"):
+            a.migrate_to(c)
+        d = _engine(1, 2)
+        d.drain()
+        e = _engine(1, 2)
+        with pytest.raises(RuntimeError, match="target is itself"):
+            e.migrate_to(d)
+
+    def test_capacity_validation_moves_nothing(self):
+        prompts = _prompts(9, (5, 6, 7))
+        src = _engine(1, 3)
+        dst = _engine(1, 1)  # too small for 3 suspended slots
+        handles = [
+            src.submit(p, max_new_tokens=8) for p in prompts
+        ]
+        src.step()
+        src.drain()
+        with pytest.raises(RuntimeError, match="free"):
+            src.migrate_to(dst)
+        # atomic: everything still on the source, nothing on the target
+        assert len(src.scheduler.running) == 3
+        assert not dst.scheduler.has_work()
+        assert dst.scheduler.free_slot_count == 1
+        del handles

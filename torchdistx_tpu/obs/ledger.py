@@ -277,7 +277,9 @@ _SERVE_HIST_TIMINGS = ("ttft_s", "e2e_latency_s", "decode_token_s", "tpot_s")
 #: serve-phase fields that define the workload fingerprint.  ``mesh``
 #: (the TP degree, 1 for single-chip) keeps TP-serve counter rows from
 #: colliding with single-chip pins; ``chunked_prefill`` likewise splits
-#: the chunked-prefill A/B phases, whose dispatch counters differ.
+#: the chunked-prefill A/B phases, whose dispatch counters differ;
+#: ``mesh_to`` (the migrate phase's target TP degree) keeps each
+#: source->target shape pair's migration wire-byte pins distinct.
 _SERVE_WORKLOAD_KEYS = (
     "model",
     "requests",
@@ -289,6 +291,7 @@ _SERVE_WORKLOAD_KEYS = (
     "page_size",
     "max_len",
     "mesh",
+    "mesh_to",
     "chunked_prefill",
     "speculate",
 )

@@ -20,6 +20,15 @@ from .pp import (
     split_microbatches,
     stack_pipeline_stages,
 )
+from .reshard import (
+    can_reshard_live,
+    devices_hold_full_copy,
+    plan_reshard,
+    reshard,
+    reshard_via_checkpoint,
+    reshard_wire_bytes,
+    split_counts,
+)
 from .tp import GSPMDTrainStep, llama_tp_rule, tp_shard_rule
 
 __all__ = [
@@ -44,6 +53,13 @@ __all__ = [
     "is_multihost",
     "process_index",
     "process_count",
+    "can_reshard_live",
+    "devices_hold_full_copy",
+    "plan_reshard",
+    "reshard",
+    "reshard_via_checkpoint",
+    "reshard_wire_bytes",
+    "split_counts",
     "pipeline_apply",
     "pipeline_train_step",
     "split_microbatches",

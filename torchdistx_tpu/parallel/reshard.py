@@ -1,0 +1,257 @@
+"""On-mesh pytree redistribution: move live state from mesh A to mesh B.
+
+The elastic half of the parallel layer (ROADMAP item 3).  A device loss
+shrinks the mesh; a serve resize changes the TP degree or slot count.  In
+both cases the *data* must move between shardings without a host round
+trip — ``jax.device_put`` to the target :class:`~jax.sharding.Sharding`
+already is that primitive (XLA lowers it to the gather/slice exchange),
+so what this module adds is the part XLA keeps invisible: the
+**closed-form wire-byte accounting** of the redistribution, priced
+against the ring cost model of ``obs/comm.py`` (arXiv:2112.01075's
+memory-efficient array redistribution: unshard = ring all-gather at
+``(g-1)/g`` of the global bytes, re-shard = local slice at zero wire
+cost) and booked into any active :func:`~torchdistx_tpu.obs.comm.
+comm_audit` — so a migration's collective footprint is a pinnable
+counter, not a guess.
+
+Two paths, chosen by :func:`can_reshard_live`:
+
+- **live** (:func:`reshard`): every leaf's full data is still reachable
+  from the target devices (replicated leaves, or leaves sharded over an
+  axis that survives intact).  One ``device_put`` per pytree, wire bytes
+  booked per leaf.
+- **checkpoint bounce** (:func:`reshard_via_checkpoint`): some shards
+  only existed on lost devices, so the live path cannot reconstruct
+  them.  Save on the old mesh (which the *simulated* loss still has —
+  a real loss would use the latest health-gated checkpoint), restore
+  straight into the target shardings (``restore_checkpoint``'s
+  ``shardings=`` seam), and book the device-side fan-out as a broadcast
+  per the same ring model.
+
+The redistribution model (per leaf, global size ``S`` bytes): comparing
+the per-dimension split counts of the source and target shardings, the
+preserved partitioning factor is ``keep = prod_d gcd(src_d, tgt_d)`` and
+the gather group size is ``g = n_src / keep`` — each group of ``g``
+source shards must be assembled into one target block, a ring
+all-gather over ``g`` participants costing ``S * (g - 1) / g`` total
+wire bytes (2112.01075 §3; ``obs.comm._WIRE["all_gather"]``).  ``g == 1``
+(pure re-slice, same layout, or replicated source) moves zero bytes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+
+from ..obs.comm import record_collective
+
+__all__ = [
+    "plan_reshard",
+    "split_counts",
+    "reshard",
+    "reshard_wire_bytes",
+    "devices_hold_full_copy",
+    "can_reshard_live",
+    "reshard_via_checkpoint",
+]
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    return int(math.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+
+
+def split_counts(shape, sharding) -> tuple:
+    """Per-dimension split counts of ``sharding`` over ``shape`` (all 1s
+    for a replicated/single-device placement)."""
+    if not shape:
+        return ()
+    try:
+        shard = sharding.shard_shape(tuple(shape))
+    except Exception:  # shardings without shard_shape: treat as unsplit
+        return tuple(1 for _ in shape)
+    return tuple(
+        -(-int(s) // int(p)) if p else 1 for s, p in zip(shape, shard)
+    )
+
+
+def _axis_label(sharding) -> str:
+    """The mesh-axis label the booked gather is filed under (the comm
+    profile keys entries by (kind, axis))."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return "reshard"
+    names = []
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            names.extend(str(p) for p in part)
+        else:
+            names.append(str(part))
+    return "+".join(names) if names else "reshard"
+
+
+def _broadcast_shardings(tree: Any, shardings: Any) -> Any:
+    """``shardings`` may be a single Sharding (applied to every leaf,
+    mirroring ``restore_checkpoint(shardings=)``) or a pytree matching
+    ``tree``."""
+    if isinstance(shardings, jax.sharding.Sharding):
+        one = shardings
+        return jax.tree_util.tree_map(lambda _: one, tree)
+    return shardings
+
+
+def plan_reshard(tree: Any, shardings: Any) -> list:
+    """The per-leaf redistribution plan (module docstring model): a list
+    of ``{"axis", "nbytes", "gather_group", "wire_bytes"}`` dicts, one
+    per leaf that must move data over the wire (``g > 1``).  Pure host
+    arithmetic over shapes and shardings — never touches the device, so
+    it can price a migration before committing to it."""
+    shardings = _broadcast_shardings(tree, shardings)
+    leaves = jax.tree_util.tree_leaves(tree)
+    targets = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+    )
+    if len(leaves) != len(targets):
+        raise ValueError(
+            f"shardings tree has {len(targets)} leaves for a state tree "
+            f"of {len(leaves)} arrays"
+        )
+    plan = []
+    for leaf, target in zip(leaves, targets):
+        if not hasattr(leaf, "shape") or not hasattr(leaf, "sharding"):
+            continue
+        src = split_counts(leaf.shape, leaf.sharding)
+        tgt = split_counts(leaf.shape, target)
+        n_src = int(np.prod(src)) if src else 1
+        keep = int(
+            np.prod([math.gcd(a, b) for a, b in zip(src, tgt)])
+        ) if src else 1
+        if n_src <= keep:
+            continue  # replicated source or preserved layout: local slice
+        g = n_src // keep
+        nbytes = _leaf_bytes(leaf)
+        plan.append(
+            {
+                "axis": _axis_label(leaf.sharding),
+                "nbytes": nbytes,
+                "gather_group": g,
+                "wire_bytes": nbytes * (g - 1) // g,
+            }
+        )
+    return plan
+
+
+def reshard_wire_bytes(tree: Any, shardings: Any) -> int:
+    """Closed-form total wire bytes :func:`reshard` will book for this
+    move — the number the migration tests and ledger counters pin."""
+    return sum(p["wire_bytes"] for p in plan_reshard(tree, shardings))
+
+
+def reshard(tree: Any, shardings: Any, *, record: bool = True) -> Any:
+    """Redistribute a live pytree into ``shardings`` (single Sharding or
+    matching pytree) on-device, booking each leaf's closed-form gather
+    into the active comm audit.  Returns the re-placed tree; leaves that
+    already satisfy their target move nothing and book nothing."""
+    shardings = _broadcast_shardings(tree, shardings)
+    if record:
+        for p in plan_reshard(tree, shardings):
+            record_collective(
+                "all_gather",
+                p["axis"],
+                payload_bytes=p["nbytes"],
+                axis_size=p["gather_group"],
+            )
+    return jax.device_put(tree, shardings)
+
+
+def devices_hold_full_copy(leaf: Any, devices: Iterable[Any]) -> bool:
+    """True when ``devices`` collectively hold every shard of ``leaf`` —
+    the per-leaf survivability test behind :func:`can_reshard_live`."""
+    devices = set(devices)
+    try:
+        index_map = leaf.sharding.devices_indices_map(tuple(leaf.shape))
+    except Exception:
+        return all(d in devices for d in leaf.sharding.device_set)
+    all_blocks = {tuple(map(str, idx)) for idx in index_map.values()}
+    surviving = {
+        tuple(map(str, idx))
+        for d, idx in index_map.items()
+        if d in devices
+    }
+    return surviving == all_blocks
+
+
+def can_reshard_live(tree: Any, target: Any) -> bool:
+    """Can every leaf of ``tree`` be rebuilt from the devices of
+    ``target`` (a Mesh, a Sharding, or a shardings pytree) alone?  False
+    means some shard's only copies sat on lost devices — take the
+    checkpoint-bounce path."""
+    if hasattr(target, "devices") and hasattr(target, "axis_names"):
+        devices = set(np.asarray(target.devices).flat)  # a Mesh
+    elif isinstance(target, jax.sharding.Sharding):
+        devices = set(target.device_set)
+    else:
+        devices = set()
+        for s in jax.tree_util.tree_leaves(
+            target, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+        ):
+            devices |= set(s.device_set)
+    return all(
+        devices_hold_full_copy(leaf, devices)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "sharding")
+    )
+
+
+def reshard_via_checkpoint(
+    tree: Any,
+    path: str,
+    shardings: Any,
+    *,
+    like: Any = None,
+    record: bool = True,
+) -> Any:
+    """The bounce path: save ``tree``, restore straight into the target
+    ``shardings`` (orbax streams each array into its placement — no
+    replicated host copy), rebuilding live pytree classes via ``like``
+    (defaults to ``tree`` itself).  Books one broadcast per target
+    device group — the host-to-mesh fan-out is the ring broadcast of the
+    2112.01075 model, ``(n-1)/n`` of the restored bytes."""
+    import os
+    import shutil
+
+    from ..utils.checkpoint import restore_checkpoint, save_checkpoint
+
+    # the bounce checkpoint is migration scratch, not a recovery point:
+    # a retried migration must be able to reuse its path
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    save_checkpoint(path, tree)
+    out = restore_checkpoint(
+        path,
+        like=tree if like is None else like,
+        shardings=shardings,
+    )
+    if record:
+        for leaf, target in zip(
+            jax.tree_util.tree_leaves(tree),
+            jax.tree_util.tree_leaves(
+                _broadcast_shardings(tree, shardings),
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding),
+            ),
+        ):
+            if not hasattr(leaf, "shape"):
+                continue
+            n = len(getattr(target, "device_set", ())) or 1
+            if n > 1:
+                record_collective(
+                    "broadcast",
+                    _axis_label(target),
+                    payload_bytes=_leaf_bytes(leaf),
+                    axis_size=n,
+                )
+    return out

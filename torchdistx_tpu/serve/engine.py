@@ -599,6 +599,10 @@ class ServeEngine:
         self.hbm_budget = hbm_budget
         self._static_footprint: Optional[dict] = None
         self._gate = self._make_admission_gate()
+        # elastic drain state (drain()/migrate_to()): a draining engine
+        # refuses new submissions and admits nothing, but keeps stepping
+        # its running slots
+        self._draining = False
         # dispatch-stall watchdog (obs.watchdog)
         self.watchdog = None
         if stall_timeout_s is not None:
@@ -621,6 +625,15 @@ class ServeEngine:
     ) -> RequestHandle:
         """Enqueue one request; returns immediately.  ``step()`` (or
         ``run``) drives it to completion."""
+        if self._draining:
+            # named refusal, not a silent queue-forever: a draining
+            # engine will never admit again, so accepting the submit
+            # would strand the request
+            self.metrics.count("submits_rejected_draining")
+            raise RuntimeError(
+                "engine is draining: new submissions are refused — "
+                "submit to the migration target engine instead"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -688,7 +701,7 @@ class ServeEngine:
                 self._finish(req, "deadline", now)
         gate = (
             self._gate
-            if (self.paged or self.hbm_budget is not None)
+            if (self._draining or self.paged or self.hbm_budget is not None)
             else None
         )
         for req, slot in self.scheduler.admit(now, gate=gate):
@@ -718,6 +731,300 @@ class ServeEngine:
         while self.step():
             pass
         return [h.result() for h in handles]
+
+    # -- elastic drain / live migration ----------------------------------
+
+    def drain(self, *, complete: bool = False) -> int:
+        """Stop admission so the engine can be resized or retired.
+
+        Queued requests stay queued — the FCFS head gets a
+        ``("gated", {"why": "draining"})`` lifecycle event naming why it
+        stopped moving — and new :meth:`submit` calls raise.  Running
+        slots keep their KV state; with ``complete=True`` the engine
+        steps until every running request finishes (queued ones still
+        wait for :meth:`migrate_to`), otherwise they stay suspended at
+        the current chunk boundary with positions, host sampling state,
+        and cache rows intact.  Persistent-mode pending first tokens are
+        flushed (one host sync) so the suspended state is complete.
+        Returns the number of unfinished requests (queued + suspended).
+        """
+        self._draining = True
+        now = time.monotonic()
+        # the queued head learns WHY it stopped moving right away — not
+        # at some later step(), and regardless of whether a slot is free
+        # (Scheduler.admit only consults the gate when one is)
+        if self.scheduler.queue_depth:
+            Scheduler._record_gated(
+                self.scheduler.queued[0], now, "draining"
+            )
+        by_slot = {r.slot: r for r in self.scheduler.running}
+        for slot, pending in list(self._pending_first.items()):
+            del self._pending_first[slot]
+            req = by_slot.get(slot)
+            if req is None:
+                continue
+            tok = int(np.asarray(pending))
+            self.metrics.count("host_syncs")
+            self._record_first(req, tok, now)
+            self._check_finished(req, tok, now)
+        if complete:
+            while self.scheduler.running:
+                self.step()
+        return self.scheduler.queue_depth + len(self.scheduler.running)
+
+    def migrate_to(self, target: "ServeEngine") -> dict:
+        """Hand every unfinished request to ``target`` — a differently
+        shaped engine (other TP degree, other slot count) over the same
+        model — without dropping any of them.
+
+        Suspended running slots move WITH their KV state: slab rows (or
+        page chains) are gathered out of this engine's sharded cache and
+        scattered into the target's, host sampling state rides along,
+        and each request resumes mid-stream — a greedy stream completes
+        bit-identically to an undrained run.  Queued requests transfer
+        rid-intact, so every outstanding :class:`RequestHandle` stays
+        valid against the target.  Validation happens before any state
+        moves (a failed migration leaves both engines untouched).
+
+        Every KV redistribution is booked into the active comm audit as
+        its closed-form ring all-gather (``parallel/reshard.py`` model:
+        group ``g`` from the split-count gcd, wire = ``S*(g-1)/g``);
+        same-layout moves book nothing.  Returns a summary dict with
+        the migrated counts, total ``wire_bytes``, and both shapes.
+        """
+        if target is self:
+            raise ValueError("cannot migrate an engine into itself")
+        if target._draining:
+            raise RuntimeError(
+                "migration target is itself draining — migrate to a "
+                "live engine"
+            )
+        if not self._draining:
+            self.drain()
+        now = time.monotonic()
+        running = sorted(
+            self.scheduler.running,
+            key=lambda r: (r.admitted_at or 0.0, r.rid),
+        )
+        queued = self.scheduler.queued
+        # -- validate everything before moving anything ------------------
+        if self.paged != target.paged:
+            raise RuntimeError(
+                "cannot migrate between slab and paged engines — KV "
+                "layouts are not interconvertible in place"
+            )
+        if self.max_len != target.max_len:
+            raise RuntimeError(
+                f"KV geometry mismatch: source max_len {self.max_len} "
+                f"!= target max_len {target.max_len}"
+            )
+        if self.paged and self.page_size != target.page_size:
+            raise RuntimeError(
+                f"page-size mismatch: source {self.page_size} != "
+                f"target {target.page_size}"
+            )
+        free_b = target.scheduler.free_slot_count
+        if len(running) > free_b:
+            raise RuntimeError(
+                f"{len(running)} suspended request(s) need slots but the "
+                f"target has only {free_b} free — drain(complete=True) "
+                "further, or migrate to a larger engine"
+            )
+        if self.paged:
+            need = sum(len(r.pages or ()) for r in running)
+            if need > target.pool.free_count:
+                raise RuntimeError(
+                    f"suspended requests hold {need} KV page(s) but the "
+                    f"target pool has only {target.pool.free_count} free"
+                )
+        for q in queued:
+            if q.prompt.size > target.prefill_buckets[-1]:
+                raise RuntimeError(
+                    f"queued request {q.rid}: prompt ({q.prompt.size}) "
+                    "exceeds the target's largest prefill bucket "
+                    f"({target.prefill_buckets[-1]})"
+                )
+            if target.paged:
+                need = -(-(q.cost) // target.page_size)
+                if need > target.pool.capacity:
+                    raise RuntimeError(
+                        f"queued request {q.rid} needs {need} pages but "
+                        f"the target pool holds only "
+                        f"{target.pool.capacity}"
+                    )
+        # -- move suspended slots (KV + host sampling state) -------------
+        wire = 0
+        n_coll = 0
+        pages_moved = 0
+        for req in running:
+            s_a = req.slot
+            pos_a = int(self.cache.pos[s_a])
+            pages_a = list(req.pages) if (self.paged and req.pages) else None
+            s_b = target.scheduler.adopt_running(req)  # sets req.slot
+            if self.paged:
+                new_pages = target.pool.alloc(len(pages_a))
+                w, c = self._copy_kv_pages(target, pages_a, new_pages)
+                target.cache.set_table(s_b, new_pages)
+                pages_moved += len(pages_a)
+            else:
+                w, c = self._copy_kv_slot(target, s_a, s_b)
+            wire += w
+            n_coll += c
+            # detach from the source AFTER the copy (retire validates the
+            # slot mapping, so it must see the request still attached —
+            # but adopt_running already rewrote req.slot, so point the
+            # validation at the source slot for the handoff)
+            req.slot = s_a
+            self.scheduler.retire(req)
+            req.slot = s_b
+            self.cache.retire(s_a)
+            if pages_a is not None:
+                self.pool.decref(pages_a)
+                req.pages = new_pages  # prefix-shared pages become private
+            target.cache.admit(s_b, pos_a)
+            for arr_a, arr_b in (
+                (self._last_tok, target._last_tok),
+                (self._temps, target._temps),
+                (self._seeds, target._seeds),
+                (self._ntok, target._ntok),
+                (self._budget, target._budget),
+                (self._hist, target._hist),
+            ):
+                arr_b[s_b] = arr_a[s_a]
+            req.record_event("migrated", ts=now, from_slot=s_a, to_slot=s_b)
+            self.metrics.count("requests_migrated_out")
+            target.metrics.count("requests_migrated_in")
+        # -- move the queue (rid-intact, FCFS order preserved) -----------
+        for req in self.scheduler.drain_queue():
+            req.record_event("migrated", ts=now, queued=True)
+            target.scheduler.adopt_queued(req)
+            self.metrics.count("requests_migrated_out")
+            target.metrics.count("requests_migrated_in")
+        if self.paged and self.prefix_index is not None:
+            # the source cache is decommissioned: shared-prefix pages the
+            # radix index kept pinned for future hits have nothing left
+            # to hit against — release them all
+            self.prefix_index.evict(self.pool, self.pool.capacity)
+        self.metrics.count("migration_wire_bytes", wire)
+        return {
+            "migrated_running": len(running),
+            "migrated_queued": len(queued),
+            "pages_moved": pages_moved,
+            "wire_bytes": int(wire),
+            "collectives": int(n_coll),
+            "tp_from": self.tp,
+            "tp_to": target.tp,
+            "slots_from": self.num_slots,
+            "slots_to": target.num_slots,
+        }
+
+    @staticmethod
+    def _kv_unit_sharding(dst, *, lead_none: bool):
+        """The sharding of one slot row (``lead_none=False``: the leading
+        slot/page dim is dropped) or one page segment (``lead_none=True``:
+        the leading dim stays, unsharded) of ``dst`` — what the gathered
+        unit is placed to before scattering in, so the ``.at[].set``
+        update stays layout-compatible with the target cache."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = dst.sharding
+        if not isinstance(sh, NamedSharding):
+            return sh
+        spec = list(sh.spec) + [None] * (dst.ndim - len(sh.spec))
+        rest = spec[1:]
+        return NamedSharding(
+            sh.mesh,
+            PartitionSpec(*([None] + rest if lead_none else rest)),
+        )
+
+    @staticmethod
+    def _kv_migration_group(src, dst) -> int:
+        """Ring gather group for moving one slot row / page chain between
+        two differently-sharded KV arrays.  Dim 0 is the slot/page index
+        — never sharded, and sized differently across engines — so the
+        group comes from the remaining dims (the head axis under TP),
+        per the ``parallel/reshard.py`` split-count model."""
+        import math as _math
+
+        from ..parallel.reshard import split_counts
+
+        src_c = split_counts(src.shape, src.sharding)[1:]
+        tgt_c = split_counts(dst.shape, dst.sharding)[1:]
+        n_src = int(np.prod(src_c)) if src_c else 1
+        keep = 1
+        for a, b in zip(src_c, tgt_c):
+            keep *= _math.gcd(int(a), int(b))
+        return max(1, n_src // max(1, keep))
+
+    def _copy_kv_slot(self, target, s_a: int, s_b: int):
+        """Move slab slot ``s_a``'s KV rows into ``target`` slot ``s_b``,
+        booking the tp redistribution per layer/array.  Returns
+        (wire_bytes, collectives)."""
+        wire = 0
+        n_coll = 0
+        new_kv = []
+        for (ka, va), (kb, vb) in zip(self.cache.kv, target.cache.kv):
+            pair = []
+            for src, dst in ((ka, kb), (va, vb)):
+                g = self._kv_migration_group(src, dst)
+                unit = int(np.prod(src.shape[1:])) * np.dtype(
+                    src.dtype
+                ).itemsize
+                if g > 1:
+                    record_collective(
+                        "all_gather",
+                        self.tp_axis,
+                        payload_bytes=unit,
+                        axis_size=g,
+                    )
+                    wire += unit * (g - 1) // g
+                    n_coll += 1
+                row = jax.device_put(
+                    src[s_a], self._kv_unit_sharding(dst, lead_none=False)
+                )
+                out = dst.at[s_b].set(row)
+                # re-assert the cache layout: the scatter result must not
+                # drift to a layout that would recompile the decode jit
+                pair.append(jax.device_put(out, dst.sharding))
+            new_kv.append(tuple(pair))
+        target.cache.kv = new_kv
+        return wire, n_coll
+
+    def _copy_kv_pages(self, target, pages_a: List[int], pages_b: List[int]):
+        """Move a page chain between paged pools (one gather/scatter per
+        layer/array over the whole chain).  Returns (wire_bytes,
+        collectives)."""
+        idx_a = jnp.asarray(pages_a, jnp.int32)
+        idx_b = jnp.asarray(pages_b, jnp.int32)
+        n = len(pages_a)
+        wire = 0
+        n_coll = 0
+        new_kv = []
+        for (ka, va), (kb, vb) in zip(self.cache.kv, target.cache.kv):
+            pair = []
+            for src, dst in ((ka, kb), (va, vb)):
+                g = self._kv_migration_group(src, dst)
+                unit = int(np.prod(src.shape[1:])) * np.dtype(
+                    src.dtype
+                ).itemsize
+                if g > 1 and n:
+                    record_collective(
+                        "all_gather",
+                        self.tp_axis,
+                        payload_bytes=unit,
+                        count=n,
+                        axis_size=g,
+                    )
+                    wire += (unit * (g - 1) // g) * n
+                    n_coll += 1
+                seg = jax.device_put(
+                    src[idx_a], self._kv_unit_sharding(dst, lead_none=True)
+                )
+                out = dst.at[idx_b].set(seg)
+                pair.append(jax.device_put(out, dst.sharding))
+            new_kv.append(tuple(pair))
+        target.cache.kv = new_kv
+        return wire, n_coll
 
     def finished_requests(self) -> List[Request]:
         """The bounded finished-request history (newest last): each entry
@@ -1181,6 +1488,11 @@ class ServeEngine:
 
         def gate(req: Request) -> bool:
             gate.why = "gate"
+            if self._draining:
+                # checked before hbm/pages so a draining refusal never
+                # reserves anything the migration would have to unwind
+                gate.why = "draining"
+                return False
             if self.hbm_budget is not None:
                 plan = self.memory_plan()
                 if plan["fits"] is False:

@@ -201,6 +201,10 @@ class ServeMetrics:
             "prefix_hit_tokens": 0,
             "pages_evicted": 0,
             "admissions_rejected_hbm": 0,
+            "submits_rejected_draining": 0,
+            "requests_migrated_out": 0,
+            "requests_migrated_in": 0,
+            "migration_wire_bytes": 0,
         }
         self.queue_depth = 0
         self.active_slots = 0

@@ -184,6 +184,12 @@ class Scheduler:
         return len(self._queue)
 
     @property
+    def queued(self) -> List[Request]:
+        """Snapshot of the queue in FCFS order (for migration planning —
+        the queue itself is not exposed)."""
+        return list(self._queue)
+
+    @property
     def running(self) -> List[Request]:
         return list(self._running.values())
 
@@ -191,8 +197,43 @@ class Scheduler:
     def in_flight_tokens(self) -> int:
         return self._in_flight_tokens
 
+    @property
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
     def has_work(self) -> bool:
         return bool(self._queue) or bool(self._running)
+
+    # -- migration (ServeEngine.migrate_to) ------------------------------
+
+    def adopt_running(self, request: Request) -> int:
+        """Attach an already-admitted request arriving from another
+        engine: claim a free slot WITHOUT re-running admission gates (the
+        migration validated capacity up front, and re-gating a request
+        that already holds KV state could deadlock the handoff).  Keeps
+        the request's rid, events, and generated tokens intact; returns
+        the claimed slot."""
+        if not self._free_slots:
+            raise RuntimeError(
+                f"no free slot to adopt request {request.rid} into"
+            )
+        slot = self._free_slots.pop()
+        request.slot = slot
+        self._running[slot] = request
+        self._in_flight_tokens += request.cost
+        return slot
+
+    def adopt_queued(self, request: Request) -> None:
+        """Append an already-submitted request (rid intact — its handle
+        stays valid) to the back of the queue."""
+        self._queue.append(request)
+
+    def drain_queue(self) -> List[Request]:
+        """Remove and return every queued request in FCFS order — the
+        migration's queue handoff."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
 
     # -- admission -------------------------------------------------------
 

@@ -70,7 +70,9 @@ class Trainer:
         # failure handling (utils.failure): losses are checked at log
         # boundaries (where they are realized anyway — zero extra syncs);
         # on_failure: "raise" | "continue" (log-only) | "restore" (roll
-        # back to the latest health-gated checkpoint — elastic recovery).
+        # back to the latest health-gated checkpoint) | "reshard"
+        # (device_loss: shrink the mesh and migrate live state onto the
+        # survivors — parallel/reshard.py; other kinds roll back).
         # For suppressing the poisoned update ITSELF, wrap the optimizer
         # with utils.failure.guard_nonfinite_updates.
         self.failure_detector = failure_detector
@@ -99,6 +101,7 @@ class Trainer:
         self._t_compile = 0.0
         self._t_checkpoint = 0.0
         self._t_rollback = 0.0
+        self._t_reshard = 0.0  # elastic migration time (disjoint from rollback)
         # only the FIRST fit()'s first step carries the jit compile; a
         # later fit on the same (warm) step program must not book its
         # first window as compile overhead or goodput reads low
@@ -186,6 +189,142 @@ class Trainer:
         self.params = out["params"]
         self.opt_state = out["opt_state"]
         self.global_step = int(out["global_step"])
+
+    # -- elastic resharding ------------------------------------------------
+
+    @staticmethod
+    def _shrunk_mesh(mesh, n_lost: int):
+        """The surviving mesh after losing the LAST ``n_lost`` devices of
+        ``mesh``'s flat device order (the injection contract — a real
+        loss would pass the survivor mesh to :meth:`reshard` directly).
+        The shrink factor is absorbed by the outermost axis that divides
+        it, so ('dp','fsdp')=(2,4) losing a replica becomes (1,4) and a
+        flat fsdp=8 mesh becomes fsdp=4."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from .utils.failure import StepFailure
+
+        devices = list(np.asarray(mesh.devices).flat)
+        n_surv = len(devices) - int(n_lost)
+        if n_surv < 1 or len(devices) % n_surv != 0:
+            raise StepFailure(
+                "device_loss",
+                f"cannot shrink a {len(devices)}-device mesh to "
+                f"{n_surv} survivors (need a divisor)",
+            )
+        factor = len(devices) // n_surv
+        shape = {ax: int(mesh.shape[ax]) for ax in mesh.axis_names}
+        for ax in shape:
+            if shape[ax] % factor == 0:
+                shape[ax] //= factor
+                break
+        else:
+            raise StepFailure(
+                "device_loss",
+                f"no mesh axis of {dict(mesh.shape)} divides the shrink "
+                f"factor {factor}",
+            )
+        arr = np.asarray(devices[:n_surv]).reshape(tuple(shape.values()))
+        return Mesh(arr, tuple(shape))
+
+    def reshard(self, failure: Any = None, *, mesh: Any = None) -> str:
+        """Elastic recovery: move params + optimizer state onto a shrunk
+        mesh and re-jit the step with the new shardings (ROADMAP item 3;
+        the ``on_failure="reshard"`` leg of the failure policy).
+
+        The target ``mesh`` defaults to :meth:`_shrunk_mesh` of the
+        step's current mesh by ``failure.n_lost`` devices.  State moves
+        via :func:`~torchdistx_tpu.parallel.reshard.reshard` when the
+        survivors still hold a full copy of every leaf, else via the
+        checkpoint bounce (save on A, ``restore_checkpoint`` straight
+        into the B shardings).  Either way the migration's collective
+        footprint is booked into ``self.comm_profile`` (the closed-form
+        arXiv:2112.01075 pricing), its wall time into the ``_t_reshard``
+        goodput bucket, and the flight recorder gets
+        ``reshard_start``/``reshard_done`` naming both mesh shapes.
+        Returns the migration mode used: ``"live"`` or ``"checkpoint"``.
+        """
+        import copy
+        import dataclasses
+
+        from .obs.comm import comm_audit as _audit
+        from .parallel.fsdp import optimizer_state_shardings
+        from .parallel.reshard import (
+            can_reshard_live,
+            reshard as _reshard,
+            reshard_via_checkpoint,
+        )
+        from .utils.failure import StepFailure
+
+        old_mesh = getattr(self.step, "mesh", None)
+        if old_mesh is None or not hasattr(self.step, "param_sharding"):
+            raise StepFailure(
+                getattr(failure, "kind", "device_loss"),
+                f"{failure} (and the step carries no mesh to reshard)",
+            )
+        if mesh is None:
+            mesh = self._shrunk_mesh(
+                old_mesh, getattr(failure, "n_lost", None) or 1
+            )
+        mesh_from = {ax: int(old_mesh.shape[ax]) for ax in old_mesh.axis_names}
+        mesh_to = {ax: int(mesh.shape[ax]) for ax in mesh.axis_names}
+        t0 = time.time()
+        self.flight.record(
+            "reshard_start",
+            step=self.global_step,
+            mesh_from=mesh_from,
+            mesh_to=mesh_to,
+        )
+        # fresh step object on the new mesh: _jitted resets, so the next
+        # call re-builds (and re-jits) with the new out_shardings
+        if dataclasses.is_dataclass(self.step):
+            new_step = dataclasses.replace(self.step, mesh=mesh)
+        else:
+            new_step = copy.copy(self.step)
+            new_step.mesh = mesh
+            if hasattr(new_step, "_jitted"):
+                new_step._jitted = None
+        params_sh = new_step.param_sharding(self.params)
+        live = can_reshard_live(
+            {"params": self.params, "opt_state": self.opt_state}, mesh
+        )
+        migration = CommProfile()
+        with _audit(self.comm_profile), _audit(migration):
+            if live:
+                self.params = _reshard(self.params, params_sh)
+                opt_sh = optimizer_state_shardings(
+                    self.opt_state, self.params, mesh
+                )
+                self.opt_state = _reshard(self.opt_state, opt_sh)
+            else:
+                base = os.path.join(
+                    self.checkpoint_dir or ".",
+                    f"reshard_{self.global_step}",
+                )
+                self.params = reshard_via_checkpoint(
+                    self.params, base + "_params", params_sh
+                )
+                opt_sh = optimizer_state_shardings(
+                    self.opt_state, self.params, mesh
+                )
+                self.opt_state = reshard_via_checkpoint(
+                    self.opt_state, base + "_opt", opt_sh
+                )
+        self.step = new_step
+        dt = time.time() - t0
+        self._t_reshard += dt
+        mode = "live" if live else "checkpoint"
+        self.flight.record(
+            "reshard_done",
+            step=self.global_step,
+            mesh_from=mesh_from,
+            mesh_to=mesh_to,
+            mode=mode,
+            wire_bytes=int(migration.wire_bytes()),
+            seconds=round(dt, 3),
+        )
+        return mode
 
     # -- loop --------------------------------------------------------------
 
@@ -301,6 +440,7 @@ class Trainer:
             self.metrics["flop_attribution"] = card.flop_attribution
         overhead = (
             self._t_compile + self._t_checkpoint + self._t_rollback
+            + self._t_reshard
         )
         if self._t_productive + overhead > 0:
             self.metrics["goodput"] = self._t_productive / (
@@ -377,6 +517,10 @@ class Trainer:
                     from .utils.failure import StepFailure, apply_failure_policy
 
                     try:
+                        if hasattr(self.failure_detector, "check_devices"):
+                            self.failure_detector.check_devices(
+                                self.global_step
+                            )
                         self.failure_detector.check_loss(
                             self.global_step, last_loss
                         )
@@ -400,12 +544,18 @@ class Trainer:
                             last_checkpoint=self._last_checkpoint,
                         )
                         t_rb = time.time()
+                        rs0 = self._t_reshard
                         # "raise" propagates: _fit's wrapper records the
                         # exception and dumps the ring before re-raising
                         action = apply_failure_policy(
                             self, failure, self.on_failure
                         )
-                        self._t_rollback += time.time() - t_rb
+                        # reshard() books its own time into _t_reshard;
+                        # keep the goodput buckets disjoint
+                        self._t_rollback += max(
+                            0.0,
+                            time.time() - t_rb - (self._t_reshard - rs0),
+                        )
                         self.flight.record(
                             "rollback",
                             step=failed_step,

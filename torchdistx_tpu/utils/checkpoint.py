@@ -58,10 +58,12 @@ def _restore_args_from_template(meta: Any, template: Any):
     metadata tree, taking each leaf's target sharding from ``template``.
 
     ``template`` carries the live pytree classes (optimizer NamedTuples,
-    dicts, lists) and sharded arrays; ``meta`` is orbax's serialized shape
-    of the same state (NamedTuples as dicts keyed by field name, tuples as
-    dicts keyed by index).  The walk is meta-driven so entries that
-    legitimately vanish in serialization (empty containers) are skipped.
+    dicts, lists) and, at the leaves, either sharded arrays or bare
+    :class:`~jax.sharding.Sharding` targets (the ``shardings=`` pytree
+    form); ``meta`` is orbax's serialized shape of the same state
+    (NamedTuples as dicts keyed by field name, tuples as dicts keyed by
+    index).  The walk is meta-driven so entries that legitimately vanish
+    in serialization (empty containers) are skipped.
     """
     import orbax.checkpoint as ocp
 
@@ -78,6 +80,8 @@ def _restore_args_from_template(meta: Any, template: Any):
             if isinstance(m, dict):
                 return {k: walk(m[k], t[int(k)]) for k in m}
             return [walk(mm, tt) for mm, tt in zip(m, t)]
+        if isinstance(t, jax.sharding.Sharding):
+            return ocp.ArrayRestoreArgs(sharding=t)
         if isinstance(t, jax.Array):
             return ocp.ArrayRestoreArgs(sharding=t.sharding)
         return ocp.RestoreArgs()
@@ -125,21 +129,16 @@ def restore_checkpoint(
         out = ckptr.restore(path)
     else:
         meta = _metadata_tree(ckptr, path)
-
-        def spec_for(leaf_meta, sh):
-            return (
-                ocp.ArrayRestoreArgs(sharding=sh)
-                if sh is not None
-                else ocp.RestoreArgs()
-            )
-
         if not isinstance(shardings, (dict, list, tuple)):
             one = shardings
             restore_args = jax.tree_util.tree_map(
-                lambda m: spec_for(m, one), meta
+                lambda m: ocp.ArrayRestoreArgs(sharding=one), meta
             )
         else:
-            restore_args = jax.tree_util.tree_map(spec_for, meta, shardings)
+            # the same meta-driven walk as shardings_from, so the
+            # shardings pytree may carry the STATE's live classes
+            # (optimizer NamedTuples) rather than orbax's plain nests
+            restore_args = _restore_args_from_template(meta, shardings)
         out = ckptr.restore(path, restore_args=restore_args)
 
     if like is not None:

@@ -27,7 +27,10 @@ Trainer policies (``on_failure``): ``"raise"`` stops the run,
 ``"restore"`` rolls back to the latest *health-gated* checkpoint and
 continues, ``"continue"`` only logs (observability; the parameters keep
 whatever the step wrote — pair with :func:`guard_nonfinite_updates` if the
-update itself must be suppressed).
+update itself must be suppressed), ``"reshard"`` handles ``device_loss``
+by shrinking the mesh and migrating live state onto the survivors
+(``parallel/reshard.py``), falling back to ``"restore"`` semantics for
+non-topology failures.
 """
 
 from __future__ import annotations
@@ -62,7 +65,7 @@ class StepFailure(RuntimeError):
 
     def __init__(self, kind: str, message: str) -> None:
         super().__init__(message)
-        self.kind = kind  # "nonfinite" | "deadline"
+        self.kind = kind  # "nonfinite" | "deadline" | "device_loss"
 
 
 class FailureDetector:
@@ -86,6 +89,42 @@ class FailureDetector:
         self.step_deadline_s = step_deadline_s
         self._consecutive_nonfinite = 0
         self.failures: list[dict] = []  # observability: what happened when
+        # device-loss injection seam (tests / crash_injection_smoke):
+        # ``inject_device_loss(n)`` makes the NEXT health check report
+        # the named devices gone.  A real deployment sets this from its
+        # platform's health feed (PJRT has no portable device-health API;
+        # the detection contract is external, like the Heartbeat).
+        self._lost_devices: Optional[int] = None
+
+    # -- device health -----------------------------------------------------
+
+    def inject_device_loss(self, n_lost: int) -> None:
+        """Arm a simulated loss of ``n_lost`` devices; the next
+        :meth:`check_devices` (run by the trainer at the same log
+        boundary that checks the loss) raises ``device_loss``.  The
+        injectable twin of the NaN path — what the elastic tests and the
+        crash-injection smoke drive."""
+        if n_lost < 1:
+            raise ValueError(f"n_lost must be >= 1, got {n_lost}")
+        self._lost_devices = int(n_lost)
+
+    def check_devices(self, step: int) -> None:
+        """Raise :class:`StepFailure('device_loss')` when a device loss
+        is pending (injected, or wired from a platform health feed)."""
+        if self._lost_devices is None:
+            return
+        n = self._lost_devices
+        self._lost_devices = None
+        self.failures.append(
+            {"step": step, "kind": "device_loss", "n_lost": n}
+        )
+        err = StepFailure(
+            "device_loss",
+            f"step {step}: {n} device(s) reported lost — the mesh must "
+            "shrink before the next collective",
+        )
+        err.n_lost = n  # the reshard policy sizes the survivor mesh from this
+        raise err
 
     def reset(self) -> None:
         """Forget transient state after a failure has been HANDLED, so the
@@ -258,7 +297,9 @@ def apply_failure_policy(
 
     Returns the action taken: "raise" never returns, "continue" keeps
     current state (log-only), "restore" rolled back to the latest
-    health-gated checkpoint.  Handled failures reset the detector's
+    health-gated checkpoint, "reshard" shrank the mesh and migrated live
+    state onto the survivors (device_loss failures; anything else falls
+    back to the restore path).  Handled failures reset the detector's
     transient counters so its tolerance applies afresh.
     """
     if policy == "raise":
@@ -268,6 +309,16 @@ def apply_failure_policy(
         if det is not None:
             det.reset()
         return "continued"
+    if policy == "reshard":
+        if failure.kind == "device_loss" and hasattr(trainer, "reshard"):
+            trainer.reshard(failure)
+            if det is not None:
+                det.reset()
+            return "resharded"
+        # Non-elastic failures (nonfinite, deadline) under the elastic
+        # policy still mean *state* is suspect, not *topology* — roll
+        # back like "restore" does.
+        policy = "restore"
     if policy == "restore":
         if not getattr(trainer, "_last_checkpoint", None):
             raise StepFailure(
