@@ -170,6 +170,25 @@ def _parse_args():
         "as ledger counter rows (workload key 'mesh_to')",
     )
     ap.add_argument(
+        "--fleet",
+        type=int,
+        default=None,
+        metavar="N",
+        help="append the fleet phases (ISSUE 13): an N-replica "
+        "ServeFleet A/B on a shared-prefix arrival stream — affinity vs "
+        "round-robin routing, prefix hit-rate and p50 TTFT, streams "
+        "pinned bit-identical to one engine — plus a mid-workload "
+        "fleet.remove() drain leg (zero drops)",
+    )
+    ap.add_argument(
+        "--disaggregate",
+        action="store_true",
+        help="with --fleet: append the disaggregated leg — a prefill "
+        "(tp=2) and a decode (tp=1) engine behind the router, every "
+        "finished prefill's KV handed off as an explicit head-axis "
+        "redistribution pinned closed-form against the comm audit",
+    )
+    ap.add_argument(
         "--artifact",
         default=None,
         help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
@@ -262,6 +281,32 @@ def _phase_summary(rec: dict) -> dict:
             gap_reduction=rec.get("gap_reduction"),
             interleaved_dispatches=rec.get("interleaved_dispatches"),
         )
+    if "prefix_hit_rate_affinity" in rec:  # the fleet routing A/B
+        out.update(
+            prefix_hit_rate_affinity=rec.get("prefix_hit_rate_affinity"),
+            prefix_hit_rate_round_robin=rec.get(
+                "prefix_hit_rate_round_robin"
+            ),
+            ttft_p50_s_affinity=rec.get("ttft_p50_s_affinity"),
+            ttft_p50_s_round_robin=rec.get("ttft_p50_s_round_robin"),
+            streams_identical=rec.get("streams_identical"),
+        )
+    if "remove_summary" in rec:  # the fleet drain leg
+        out.update(
+            streams_identical=rec.get("streams_identical"),
+            migrated_running=(rec.get("remove_summary") or {}).get(
+                "migrated_running"
+            ),
+            migrated_queued=(rec.get("remove_summary") or {}).get(
+                "migrated_queued"
+            ),
+        )
+    if "handoff_wire_bytes_expected" in rec:  # the disaggregated leg
+        out.update(
+            streams_identical=rec.get("streams_identical"),
+            handoff_wire_bytes=counters.get("handoff_wire_bytes"),
+            requests_handed_off=counters.get("requests_handed_off"),
+        )
     if (rec.get("mesh") or 1) > 1:
         # the tdx-comm-v1 profile embedded by the TP phases
         comm = rec.get("comm") or {}
@@ -347,6 +392,21 @@ def _supervise(args) -> None:
                 },
             )
         )
+    if args.fleet is not None:
+        # the routing A/B first (its STRICT verdict is the headline),
+        # then the scale-event leg, then (opt-in) disaggregation
+        for fname in ["fleet", "fleet_drain"] + (
+            ["fleet_disagg"] if args.disaggregate else []
+        ):
+            plan.append(
+                (
+                    fname,
+                    {
+                        "TDX_SERVE_CHUNK": str(chunks[-1]),
+                        "TDX_SERVE_PHASE": fname,
+                    },
+                )
+            )
 
     def emit():
         # the speculation A/B verdict, before the summary snapshots it:
@@ -414,7 +474,13 @@ def _supervise(args) -> None:
             continue
         cmd = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:]
         env = dict(os.environ, TDX_SERVE_CHILD="1", **phase_env)
-        n_dev = max(args.tp, args.migrate_tp_to or 1)
+        n_dev = max(
+            args.tp,
+            args.migrate_tp_to or 1,
+            # the disaggregated fleet leg builds its prefill engine on a
+            # 2-device ('tp',) mesh regardless of --tp
+            2 if (args.fleet is not None and args.disaggregate) else 1,
+        )
         if n_dev > 1 and env.get("TDX_BENCH_PLATFORM") == "cpu":
             # the CPU smoke needs enough virtual devices for the mesh
             # (the migrate phase may need MORE than --tp for its target);
@@ -1362,6 +1428,411 @@ def _child_migrate(args) -> None:
     print(json.dumps(record))
 
 
+def _dump_obs_fleet(record: dict, fleet, tag: str) -> None:
+    """``_dump_obs`` for a whole fleet: ONE scrape surface — the
+    exposition renders the fleet collector (replica-summed
+    ``tdx_serve_*_total`` counters, so ``check_obs_artifacts`` validates
+    them against the embedded aggregate ``metrics`` exactly as for a
+    single engine, plus per-replica ``tdx_fleet_*`` gauges) — and the
+    Perfetto trace comes from the replica holding the most finished
+    requests (every replica shares the process tracer, so the spans are
+    fleet-wide; the lifecycle tracks are that replica's)."""
+    out_dir = os.environ.get("TDX_SERVE_TRACE_DIR")
+    if not out_dir:
+        return
+    from torchdistx_tpu import obs
+
+    os.makedirs(out_dir, exist_ok=True)
+    rep = max(
+        fleet.replicas, key=lambda r: len(r.engine.finished_requests())
+    )
+    trace_path = os.path.join(out_dir, f"{tag}_trace.json")
+    rep.engine.dump_trace(trace_path)
+    finished = [
+        r
+        for rp in fleet.replicas
+        for r in rp.engine.finished_requests()
+    ]
+    record["trace_path"] = trace_path
+    record["trace_summary"] = {
+        "requests": len(finished),
+        "lifecycle_events": sum(len(r.events) for r in finished),
+        "tracer_spans": len(obs.get_tracer().events()),
+    }
+    registry = obs.MetricsRegistry()
+    registry.register_collector(fleet.collector())
+    registry.register_collector(rep.engine.cost_book.collector())
+    prom_path = os.path.join(out_dir, f"{tag}_metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(registry.render())
+    record["metrics_prom_path"] = prom_path
+
+
+def _fleet_workload(args, n_replicas: int, page_size: int, bucket: int):
+    """The shared-prefix arrival stream of the fleet A/B: n_replicas + 1
+    prefix groups (one MORE group than replicas, so round-robin can
+    never accidentally colocate every group) arriving interleaved —
+    request k belongs to group k % groups.  Prefixes are page-aligned
+    (two pages each) so a follower's radix match is exact."""
+    import numpy as np
+
+    groups = n_replicas + 1
+    rs = np.random.RandomState(0)
+    prefix_len = 2 * page_size
+    prefixes = [
+        rs.randint(0, 256, (prefix_len,)).astype(np.int32)
+        for _ in range(groups)
+    ]
+    work = []
+    for k in range(args.requests):
+        tail = rs.randint(
+            0, 256, (1 + int(rs.randint(0, bucket - prefix_len)),)
+        ).astype(np.int32)
+        work.append(
+            {
+                "prompt": np.concatenate([prefixes[k % groups], tail])[
+                    :bucket
+                ],
+                "max_new_tokens": None,  # filled by the caller
+                "temperature": args.temperature,
+                "seed": k,
+            }
+        )
+    return work, groups
+
+
+def _child_fleet(args) -> None:
+    """The fleet routing A/B (ISSUE 13 tentpole): the SAME shared-prefix
+    arrival stream through an N-replica ``ServeFleet`` twice — affinity
+    (read-only ``match_len`` warmth, headroom tie-break) vs round-robin
+    — with fresh engines per policy.  Requests arrive online (one
+    ``submit`` + one ``step`` each), so affinity sees the caches its own
+    earlier routing warmed.  STRICT errors unless BOTH policies' greedy
+    streams are bit-identical to one engine serving the same requests
+    (routing decides where, never what) AND affinity's aggregate
+    ``prefix_hit_rate`` strictly beats round-robin's."""
+    n = int(args.fleet)
+    ps = 4  # small pages so a 16-token-bucket prompt spans whole pages
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="fleet", fleet=n, page_size=ps
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine, ServeFleet
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        bucket = 16
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        max_len = min(-(-max_len // ps) * ps, limit - limit % ps)
+        max_new = min(args.max_new, max_len - bucket)
+        work, groups = _fleet_workload(args, n, ps, bucket)
+        for w in work:
+            w["max_new_tokens"] = max_new
+        record["max_len"] = max_len
+        record["prefix_groups"] = groups
+
+        def build():
+            return ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=(bucket,),
+                page_size=ps,
+                **_mesh_kwargs(args),
+            )
+
+        # the bit-identity oracle: one engine, same requests
+        ref_tokens = [r.tokens for r in build().run([dict(w) for w in work])]
+
+        def run_policy(policy):
+            fleet = ServeFleet([build() for _ in range(n)], policy=policy)
+            t0 = time.perf_counter()
+            handles = []
+            for w in work:  # online arrival: submit, then one tick
+                handles.append(fleet.submit(**dict(w)))
+                fleet.step()
+            while fleet.step():
+                pass
+            wall = time.perf_counter() - t0
+            results = [h.result() for h in handles]
+            ttft = sorted(
+                s
+                for rep in fleet.replicas
+                for s in rep.engine.metrics.ttft_s._samples
+            )
+            return fleet, {
+                "streams": [r.tokens for r in results],
+                "hit_rate": fleet.metrics_json()["derived"][
+                    "prefix_hit_rate"
+                ],
+                "ttft_p50_s": (
+                    round(ttft[len(ttft) // 2], 6) if ttft else None
+                ),
+                "wall_s": round(wall, 3),
+            }
+
+        fleet_rr, rr = run_policy("round-robin")
+        fleet_aff, aff = run_policy("affinity")
+        streams_equal = all(
+            np.array_equal(s, ref)
+            for side in (rr, aff)
+            for s, ref in zip(side["streams"], ref_tokens)
+        )
+        record["streams_identical"] = streams_equal
+        record["prefix_hit_rate_affinity"] = aff["hit_rate"]
+        record["prefix_hit_rate_round_robin"] = rr["hit_rate"]
+        record["ttft_p50_s_affinity"] = aff["ttft_p50_s"]
+        record["ttft_p50_s_round_robin"] = rr["ttft_p50_s"]
+        record["drain_wall_s"] = aff["wall_s"]
+        record["routed_per_replica_affinity"] = [
+            r["requests_routed"]
+            for r in fleet_aff.metrics_json()["fleet"]["replicas"]
+        ]
+        # the affinity fleet's aggregate is the phase metrics: its
+        # counters (hit/lookup tokens included) are the pinned rows
+        record["metrics"] = fleet_aff.metrics_json()
+        busiest = max(
+            fleet_aff.replicas,
+            key=lambda r: len(r.engine.finished_requests()),
+        )
+        _embed_cost(record, busiest.engine)
+        if not streams_equal:
+            record["error"] = (
+                "a fleet-routed stream diverged from the single-engine "
+                "oracle — routing must decide where, never what"
+            )
+        elif not (
+            aff["hit_rate"] is not None
+            and rr["hit_rate"] is not None
+            and aff["hit_rate"] > rr["hit_rate"]
+        ):
+            record["error"] = (
+                f"affinity prefix_hit_rate {aff['hit_rate']} does not "
+                f"strictly beat round-robin {rr['hit_rate']}"
+            )
+        _dump_obs_fleet(record, fleet_aff, "fleet")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
+def _child_fleet_drain(args) -> None:
+    """The fleet scale-event leg: N replicas mid-workload, one
+    ``fleet.remove()`` — the victim drains and its in-flight requests
+    redistribute into the survivors (whole-engine ``migrate_to`` fast
+    path, or per-request scatter when no single survivor fits).  STRICT
+    errors unless every request completes with streams bit-identical to
+    an undisturbed single engine — zero drops."""
+    n = int(args.fleet)
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="fleet_drain", fleet=n
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine, ServeFleet
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        bucket = 16
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        max_new = min(args.max_new, max_len - bucket)
+        # scale-down needs headroom: cap the in-flight load at what the
+        # survivors can absorb ((n-1) replicas x slots), or the victim's
+        # requests would have nowhere to land until slots free up
+        n_req = max(2, min(args.requests, (n - 1) * args.slots))
+        rs = np.random.RandomState(1)
+        work = [
+            dict(
+                prompt=rs.randint(
+                    0, 256, (int(rs.randint(5, bucket)),)
+                ).astype(np.int32),
+                max_new_tokens=max_new,
+                temperature=0.0,
+            )
+            for _ in range(n_req)
+        ]
+        record["max_len"] = max_len
+
+        def build():
+            return ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=(bucket,),
+                **_mesh_kwargs(args),
+            )
+
+        ref_tokens = [r.tokens for r in build().run([dict(w) for w in work])]
+
+        fleet = ServeFleet([build() for _ in range(n)], policy="round-robin")
+        handles = [fleet.submit(**dict(w)) for w in work]
+        # decode just far enough that the remove() lands MID-stream
+        for _ in range(max(1, (max_new - 1) // (2 * k_chunk))):
+            fleet.step()
+        victim = fleet.replicas[0]
+        if not victim.engine.scheduler.has_work():
+            raise RuntimeError(
+                "the victim replica holds no in-flight work — nothing "
+                "to redistribute"
+            )
+        t0 = time.monotonic()
+        summary = fleet.remove(victim.rid)
+        record["remove_s"] = round(time.monotonic() - t0, 6)
+        while fleet.step():
+            pass
+        results = [h.result() for h in handles]
+        streams_equal = all(
+            np.array_equal(r.tokens, ref)
+            for r, ref in zip(results, ref_tokens)
+        )
+        record["streams_identical"] = streams_equal
+        record["remove_summary"] = {
+            k: v for k, v in summary.items() if k != "to"
+        }
+        # retired-replica counters stay in the fleet aggregate (the
+        # scrape surface is monotonic), so migration counters are
+        # pinnable straight off the embedded metrics
+        record["metrics"] = fleet.metrics_json()
+        busiest = max(
+            fleet.replicas,
+            key=lambda r: len(r.engine.finished_requests()),
+        )
+        _embed_cost(record, busiest.engine)
+        if not streams_equal:
+            record["error"] = (
+                "fleet.remove() changed a token stream — the "
+                "redistribution must be value-exact"
+            )
+        elif any(r.finish_reason != "length" for r in results):
+            record["error"] = (
+                "a request was dropped or cut short across the remove: "
+                f"{[r.finish_reason for r in results]}"
+            )
+        elif (
+            summary["migrated_running"] + summary["migrated_queued"] < 1
+        ):
+            record["error"] = (
+                "the victim held nothing by remove() time — the leg "
+                "pinned no redistribution"
+            )
+        _dump_obs_fleet(record, fleet, "fleet_drain")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
+def _child_fleet_disagg(args) -> None:
+    """The disaggregated fleet leg: a prefill engine on a 2-device
+    ('tp',) mesh and a single-chip decode engine behind the router.
+    Every request prefills on the prefill role, hands its KV slab row to
+    the decode role (explicit head-axis redistribution: tp=2 -> tp=1 is
+    gather group g=2), and decodes there.  STRICT errors unless streams
+    are bit-identical to a co-located engine, every request handed off
+    exactly once, and the handoff wire bytes equal the
+    ``parallel/reshard.py`` ring closed form — summary == comm audit ==
+    counters."""
+    n = int(args.fleet) if args.fleet else 2
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="fleet_disagg", fleet=2, disaggregate=True
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.obs.comm import comm_audit
+    from torchdistx_tpu.serve import ServeEngine, ServeFleet
+
+    try:
+        del n  # the leg is always 1 prefill + 1 decode
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        bucket = 16
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        max_new = min(args.max_new, max_len - bucket)
+        n_req = max(2, min(args.requests, args.slots + 2))
+        rs = np.random.RandomState(2)
+        work = [
+            dict(
+                prompt=rs.randint(
+                    0, 256, (int(rs.randint(5, bucket)),)
+                ).astype(np.int32),
+                max_new_tokens=max_new,
+                temperature=0.0,
+            )
+            for _ in range(n_req)
+        ]
+        record["max_len"] = max_len
+
+        def build(tp):
+            return ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=(bucket,),
+                **_mesh_kwargs(args, tp=tp),
+            )
+
+        ref_tokens = [
+            r.tokens for r in build(1).run([dict(w) for w in work])
+        ]
+        tp_pre, tp_dec = 2, 1
+        pre, dec = build(tp_pre), build(tp_dec)
+        fleet = ServeFleet(
+            [pre, dec], disaggregate=True, roles=["prefill", "decode"]
+        )
+        with comm_audit() as prof:
+            results = fleet.run(
+                [dict(w) for w in work], max_new_tokens=max_new
+            )
+        streams_equal = all(
+            np.array_equal(r.tokens, ref)
+            for r, ref in zip(results, ref_tokens)
+        )
+        record["streams_identical"] = streams_equal
+        record["comm"] = prof.to_json()
+        # the ring closed form, computed independently of the engine
+        kv0 = pre.cache.kv[0][0]
+        unit = int(np.prod(kv0.shape[1:])) * np.dtype(kv0.dtype).itemsize
+        g = max(1, tp_pre // int(np.gcd(tp_pre, tp_dec)))
+        expect = n_req * len(pre.cache.kv) * 2 * (unit * (g - 1) // g)
+        record["handoff_wire_bytes_expected"] = expect
+        record["metrics"] = fleet.metrics_json()
+        c = record["metrics"]["counters"]
+        _embed_cost(record, dec)
+        if not streams_equal:
+            record["error"] = (
+                "disaggregated streams diverged from the co-located "
+                "oracle — the handoff must be value-exact"
+            )
+        elif c.get("requests_handed_off") != n_req:
+            record["error"] = (
+                f"{c.get('requests_handed_off')} handoffs for {n_req} "
+                "requests — every request must hand off exactly once"
+            )
+        elif c.get("handoff_wire_bytes") != expect:
+            record["error"] = (
+                f"handoff wire bytes {c.get('handoff_wire_bytes')} != "
+                f"ring closed form {expect} (tp {tp_pre}->{tp_dec}, "
+                f"g={g})"
+            )
+        elif int(prof.wire_bytes("all_gather", "tp")) != expect:
+            record["error"] = (
+                f"comm audit wire {int(prof.wire_bytes('all_gather', 'tp'))} "
+                f"disagrees with the closed form {expect}"
+            )
+        _dump_obs_fleet(record, fleet, "fleet_disagg")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def main() -> None:
     args = _parse_args()
     if os.environ.get("TDX_SERVE_CHILD") == "1":
@@ -1374,6 +1845,12 @@ def main() -> None:
             _child_spec(args)
         elif phase == "migrate":
             _child_migrate(args)
+        elif phase == "fleet":
+            _child_fleet(args)
+        elif phase == "fleet_drain":
+            _child_fleet_drain(args)
+        elif phase == "fleet_disagg":
+            _child_fleet_disagg(args)
         else:
             _child(args)
     else:

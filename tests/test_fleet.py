@@ -1,0 +1,350 @@
+"""Fleet routing + lifecycle + disaggregation (ISSUE 13).
+
+The pinned invariants, on the 8-device CPU mesh:
+
+- **The router never perturbs cache state**: ``match_len`` is a pure
+  probe — no incref, no LRU tick, no recency touch — so polling every
+  replica per request leaves the losers' eviction order exactly as if
+  the probe never happened.
+- **Affinity routes to warmth, but never into a stall**: the request
+  goes to the replica whose radix index matches the longest prefix,
+  UNLESS that replica's admission gate (free pages / HBM plan) would
+  park it — then headroom wins over warmth.
+- **Routing decides where, never what**: a 3-replica fleet serving a
+  shared-prefix workload produces greedy streams BIT-identical to one
+  engine serving the same requests, under every policy.
+- **Scale events drop nothing**: a mid-workload ``fleet.remove()``
+  drains the replica through ``migrate_to`` into a survivor; every
+  outstanding handle resolves bit-identically.
+- **Disaggregated handoff is exact**: prefill(tp=2) -> decode(tp=1) KV
+  handoff books ring all-gathers at the ``parallel/reshard.py`` closed
+  form (g = 2, wire = unit/2 per layer per k/v per request), summary ==
+  comm audit == counters, and the streams match a co-located engine.
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import jax
+import torchdistx_tpu as tdx
+from torchdistx_tpu.models import Llama
+from torchdistx_tpu.obs.comm import CommProfile, comm_audit
+from torchdistx_tpu.serve import (
+    PagePool,
+    RadixPrefixIndex,
+    RoundRobinPolicy,
+    ServeEngine,
+    ServeFleet,
+)
+
+
+def _llama():
+    tdx.manual_seed(0)
+    return Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+
+
+def _tp_mesh(tp):
+    return Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+
+
+def _engine(tp, slots, paged=False, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", (32,))
+    kw.setdefault("decode_chunk", 2)
+    if paged:
+        kw.setdefault("page_size", 8)
+        kw.setdefault("num_pages", 32)
+    if tp > 1:
+        kw["mesh"] = _tp_mesh(tp)
+    return ServeEngine(_llama(), num_slots=slots, **kw)
+
+
+def _kv_unit_bytes(engine):
+    arr = engine.cache.kv[0][0]
+    return int(np.prod(arr.shape[1:])) * np.dtype(arr.dtype).itemsize
+
+
+def _shared_prefix_prompts(seed, n, prefix_len=16, tail_len=4):
+    """n prompts sharing one page-aligned prefix, distinct tails."""
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(0, 256, (prefix_len,)).astype(np.int32)
+    return [
+        np.concatenate([prefix, rs.randint(0, 256, (tail_len,)).astype(np.int32)])
+        for _ in range(n)
+    ]
+
+
+class TestMatchLenProbe:
+    """Satellite: the read-only radix probe the router polls with."""
+
+    def _warm_index(self):
+        pool = PagePool(16)
+        idx = RadixPrefixIndex(4)
+        tokens = np.arange(8, dtype=np.int32)
+        pages = pool.alloc(2)
+        idx.insert(tokens, pages, pool)
+        return pool, idx, tokens
+
+    def _snapshot(self, pool, idx):
+        def nodes(children):
+            for node in children.values():
+                yield node
+                yield from nodes(node.children)
+
+        return (
+            idx._tick,
+            [(n.page, n.last_used) for n in nodes(idx._children)],
+            [pool.refcount(p) for p in range(pool.num_pages)],
+        )
+
+    def test_agrees_with_match_caps_included(self):
+        pool, idx, tokens = self._warm_index()
+        # full 2-page chain needs a prompt of >= 9 tokens (match caps at
+        # len(prompt) - 1, like match itself)
+        long = np.concatenate([tokens, tokens])
+        assert idx.match_len(long) == 8
+        assert idx.match_len(tokens) == 4  # 8 tokens -> 1 full page
+        assert idx.match_len(tokens[:4]) == 0
+        # divergence after the first page stops the walk
+        fork = np.concatenate([tokens[:4], tokens[:4] + 1, tokens[:1]])
+        assert idx.match_len(fork) == 4
+        miss = np.asarray([9, 9, 9, 9, 9], np.int32)
+        assert idx.match_len(miss) == 0
+        # and every probe's answer equals what match would hand out
+        for p in (long, tokens, fork, miss):
+            assert idx.match_len(p) == len(idx.match(p)) * idx.page_size
+
+    def test_probe_has_no_side_effects(self):
+        pool, idx, tokens = self._warm_index()
+        before = self._snapshot(pool, idx)
+        long = np.concatenate([tokens, tokens])
+        for p in (long, tokens, np.asarray([9] * 6, np.int32)):
+            idx.match_len(p)
+        assert self._snapshot(pool, idx) == before
+        # ...whereas a real match moves the recency tick
+        idx.match(long)
+        assert self._snapshot(pool, idx) != before
+
+
+class TestRouting:
+    def test_affinity_routes_to_warm_replica(self):
+        engines = [_engine(1, 2, paged=True) for _ in range(3)]
+        warm = engines[1]
+        prompts = _shared_prefix_prompts(3, 3)
+        # warm exactly one replica's radix index with the shared prefix
+        warm.run([dict(prompt=prompts[0], max_new_tokens=2)])
+        assert warm.prefix_index.match_len(prompts[1]) == 16
+
+        fleet = ServeFleet(engines, policy="affinity")
+        warm_rid = fleet.replicas[1].rid
+        h = fleet.submit(prompts[1], max_new_tokens=2)
+        assert fleet.events[-1][0] == "routed"
+        assert fleet.events[-1][2]["replica"] == warm_rid
+        assert warm.scheduler.queue_depth == 1
+        while fleet.step():
+            pass
+        assert h.done()
+
+    def test_headroom_beats_warmth_when_warm_replica_page_gated(self):
+        # the warm replica's pool is too small for the incoming request
+        # even net of its prefix hit: affinity must fall back to a cold
+        # replica with headroom instead of routing into a page stall
+        warm = _engine(1, 2, paged=True, num_pages=4)  # 3 allocatable
+        cold = _engine(1, 2, paged=True, num_pages=32)
+        prompts = _shared_prefix_prompts(4, 2, prefix_len=8, tail_len=8)
+        warm.run([dict(prompt=prompts[0][:9], max_new_tokens=2)])
+        assert warm.prefix_index.match_len(prompts[1]) == 8
+
+        fleet = ServeFleet([warm, cold], policy="affinity")
+        # 16-token prompt + 16 new = 4 pages, hit covers 1: needs 3 free
+        # but the warm pool holds 3 - (index-held) < 3
+        assert warm.pool.free_count < 3
+        fleet.submit(prompts[1], max_new_tokens=16)
+        assert fleet.events[-1][2]["replica"] == fleet.replicas[1].rid
+        assert cold.scheduler.queue_depth == 1
+        assert warm.scheduler.queue_depth == 0
+
+    def test_round_robin_cycles_and_policy_objects_plug_in(self):
+        engines = [_engine(1, 2) for _ in range(2)]
+        fleet = ServeFleet(engines, policy=RoundRobinPolicy())
+        prompts = _shared_prefix_prompts(5, 4)
+        for p in prompts:
+            fleet.submit(p, max_new_tokens=2)
+        assert [e.scheduler.queue_depth for e in engines] == [2, 2]
+        with pytest.raises(ValueError, match="unknown policy"):
+            ServeFleet(engines, policy="warmest")
+        with pytest.raises(TypeError, match="route"):
+            ServeFleet(engines, policy=object())
+
+
+class TestFleetStreams:
+    def _workload(self, seed=7, n=6):
+        prompts = _shared_prefix_prompts(seed, n)
+        mnt = [6, 8, 10, 6, 8, 10][:n]
+        return [
+            dict(prompt=p, max_new_tokens=m) for p, m in zip(prompts, mnt)
+        ]
+
+    @pytest.mark.parametrize("policy", ["affinity", "round-robin"])
+    def test_three_replica_fleet_bit_identical_to_single_engine(
+        self, policy
+    ):
+        """The acceptance pin: routing decides where, never what."""
+        reqs = self._workload()
+        ref = _engine(1, 6, paged=True).run(reqs)
+        fleet = ServeFleet(
+            [_engine(1, 2, paged=True) for _ in range(3)], policy=policy
+        )
+        out = fleet.run(reqs)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o.tokens, r.tokens)
+            assert o.finish_reason == r.finish_reason
+        # every replica aggregates into one metrics surface
+        j = fleet.metrics_json()
+        assert j["counters"]["requests_submitted"] == len(reqs)
+        assert j["gauges"]["replicas"] == 3
+        assert sum(
+            r["requests_routed"] for r in j["fleet"]["replicas"]
+        ) == len(reqs)
+
+    def test_remove_mid_workload_drops_nothing(self):
+        reqs = self._workload(seed=9)
+        ref = _engine(1, 6).run(reqs)
+        fleet = ServeFleet([_engine(1, 3) for _ in range(3)],
+                           policy="round-robin")
+        handles = [fleet.submit(**r) for r in reqs]
+        fleet.step()  # requests admitted and mid-stream everywhere
+        victim = fleet.replicas[0]
+        assert victim.engine.scheduler.running  # it holds live work
+        summary = fleet.remove(victim.rid)
+        assert summary["replica"] == victim.rid
+        assert summary["migrated_running"] + summary["migrated_queued"] >= 1
+        assert len(fleet.replicas) == 2
+        assert all(r.rid != victim.rid for r in fleet.replicas)
+        while fleet.step():
+            pass
+        for h, r in zip(handles, ref):
+            assert h.done()
+            np.testing.assert_array_equal(h.result().tokens, r.tokens)
+        # a fleet event was logged and the victim stopped admitting
+        assert fleet.events[-1][0] == "remove"
+        # the retired replica's counters stay in the fleet aggregate
+        # (monotonic scrape surface): migrations out are still visible
+        j = fleet.metrics_json()
+        assert j["counters"]["requests_migrated_out"] >= 1
+        assert j["counters"]["requests_migrated_out"] == j["counters"][
+            "requests_migrated_in"
+        ]
+        assert j["counters"]["requests_submitted"] == len(reqs)
+        with pytest.raises(RuntimeError, match="draining"):
+            victim.engine.submit(np.ones(4, np.int32), max_new_tokens=1)
+
+    def test_add_warms_into_rotation(self):
+        fleet = ServeFleet([_engine(1, 2)], policy="round-robin")
+        rid = fleet.add(_engine(1, 2))
+        assert [r.rid for r in fleet.replicas] == [0, rid]
+        prompts = _shared_prefix_prompts(11, 2)
+        for p in prompts:
+            fleet.submit(p, max_new_tokens=2)
+        assert all(
+            r.engine.scheduler.queue_depth == 1 for r in fleet.replicas
+        )
+        with pytest.raises(RuntimeError, match="last"):
+            fleet.remove(rid), fleet.remove(0)
+
+
+class TestDisaggregated:
+    def test_handoff_streams_bit_identical_wire_exact(self):
+        """prefill(tp=2) -> decode(tp=1): streams match a co-located
+        engine; handoff wire matches the ring closed form exactly and
+        summary == comm audit == counters."""
+        reqs = [
+            dict(prompt=p, max_new_tokens=m)
+            for p, m in zip(_shared_prefix_prompts(13, 4), [6, 8, 6, 8])
+        ]
+        ref = _engine(1, 4).run(reqs)
+
+        pre = _engine(2, 4)
+        dec = _engine(1, 4)
+        fleet = ServeFleet(
+            [pre, dec], disaggregate=True, roles=["prefill", "decode"]
+        )
+        prof = CommProfile()
+        with comm_audit(prof):
+            out = fleet.run(reqs)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o.tokens, r.tokens)
+        # the prefill role never decoded: it generated exactly the first
+        # token of each request, the decode role generated the rest
+        assert pre.metrics.counters["tokens_generated"] == len(reqs)
+        assert pre.metrics.counters["decode_dispatches"] == 0
+        assert dec.metrics.counters["prefill_calls"] == 0
+        # every request handed off exactly once, wire closed-form: head
+        # axis tp=2 -> tp=1 is gather group g=2, unit/2 per layer per k/v
+        n_handoffs = pre.metrics.counters["requests_handed_off"]
+        assert n_handoffs == len(reqs)
+        assert dec.metrics.counters["requests_handed_in"] == len(reqs)
+        unit = _kv_unit_bytes(pre)
+        expect = len(reqs) * len(pre.cache.kv) * 2 * (unit // 2)
+        assert pre.metrics.counters["handoff_wire_bytes"] == expect
+        assert int(prof.wire_bytes("all_gather", "tp")) == expect
+        handoffs = [e for e in fleet.events if e[0] == "handoff"]
+        assert sum(e[2]["wire_bytes"] for e in handoffs) == expect
+        # the prefill engine ends empty: slots freed as requests moved
+        assert not pre.scheduler.has_work()
+
+    def test_same_sharding_handoff_books_zero_wire(self):
+        reqs = [
+            dict(prompt=p, max_new_tokens=4)
+            for p in _shared_prefix_prompts(15, 2)
+        ]
+        ref = _engine(1, 2).run(reqs)
+        pre, dec = _engine(1, 2), _engine(1, 2)
+        fleet = ServeFleet([pre, dec], disaggregate=True)
+        prof = CommProfile()
+        with comm_audit(prof):
+            out = fleet.run(reqs)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o.tokens, r.tokens)
+        assert pre.metrics.counters["handoff_wire_bytes"] == 0
+        assert int(prof.wire_bytes()) == 0
+
+    def test_disagg_paged_handoff_rehomes_pages(self):
+        reqs = [
+            dict(prompt=p, max_new_tokens=6)
+            for p in _shared_prefix_prompts(17, 3)
+        ]
+        ref = _engine(1, 3, paged=True).run(reqs)
+        pre = _engine(1, 3, paged=True)
+        dec = _engine(1, 3, paged=True)
+        fleet = ServeFleet([pre, dec], disaggregate=True)
+        out = fleet.run(reqs)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o.tokens, r.tokens)
+        assert pre.metrics.counters["handoff_pages_moved"] > 0
+        # source pool holds only what its radix index still caches
+        assert pre.pool.in_use == len(pre.prefix_index)
+
+    def test_disagg_validation(self):
+        with pytest.raises(ValueError, match="at least two"):
+            ServeFleet([_engine(1, 2)], disaggregate=True)
+        with pytest.raises(ValueError, match="chunked-mode"):
+            ServeFleet(
+                [
+                    ServeEngine(
+                        _llama(), num_slots=2, max_len=64,
+                        prefill_buckets=(16,),
+                        decode_mode="persistent",
+                    ),
+                    _engine(1, 2),
+                ],
+                disaggregate=True,
+            )
+        with pytest.raises(ValueError, match="incompatible"):
+            ServeFleet(
+                [_engine(1, 2), _engine(1, 2, max_len=32)],
+                disaggregate=True,
+            )
+        with pytest.raises(ValueError, match="require disaggregate"):
+            ServeFleet([_engine(1, 2)], roles=["prefill"])

@@ -279,7 +279,9 @@ _SERVE_HIST_TIMINGS = ("ttft_s", "e2e_latency_s", "decode_token_s", "tpot_s")
 #: colliding with single-chip pins; ``chunked_prefill`` likewise splits
 #: the chunked-prefill A/B phases, whose dispatch counters differ;
 #: ``mesh_to`` (the migrate phase's target TP degree) keeps each
-#: source->target shape pair's migration wire-byte pins distinct.
+#: source->target shape pair's migration wire-byte pins distinct;
+#: ``fleet``/``disaggregate`` fingerprint the fleet phases' replica
+#: count and prefill/decode split the same way.
 _SERVE_WORKLOAD_KEYS = (
     "model",
     "requests",
@@ -294,6 +296,8 @@ _SERVE_WORKLOAD_KEYS = (
     "mesh_to",
     "chunked_prefill",
     "speculate",
+    "fleet",
+    "disaggregate",
 )
 
 

@@ -9,8 +9,10 @@ max-tokens budget, free-page gating, and per-request deadlines
 (:mod:`~torchdistx_tpu.serve.scheduler`), a two-compiled-program engine
 with chunked (fused K-step scan) or persistent (whole-generation
 ``lax.while_loop`` + device output ring, host syncs ~0) decode
-(:mod:`~torchdistx_tpu.serve.engine`), and plain-dict metrics
-(:mod:`~torchdistx_tpu.serve.metrics`).
+(:mod:`~torchdistx_tpu.serve.engine`), plain-dict metrics
+(:mod:`~torchdistx_tpu.serve.metrics`), and a prefix-affinity fleet
+router over N engine replicas with drain/scale events and optional
+prefill/decode disaggregation (:mod:`~torchdistx_tpu.serve.fleet`).
 
 Observability (docs/observability.md): every request carries a
 lifecycle event log, the engine exports per-request Perfetto traces
@@ -20,6 +22,12 @@ the metric set in Prometheus text format through
 """
 
 from .engine import ServeEngine
+from .fleet import (
+    AffinityPolicy,
+    LeastLoadedPolicy,
+    RoundRobinPolicy,
+    ServeFleet,
+)
 from .kv_cache import PagedKVCache, SlotKVCache
 from .metrics import Histogram, ServeMetrics
 from .prefix_cache import PagePool, RadixPrefixIndex
@@ -27,6 +35,10 @@ from .scheduler import Request, RequestHandle, RequestResult, Scheduler
 
 __all__ = [
     "ServeEngine",
+    "ServeFleet",
+    "AffinityPolicy",
+    "LeastLoadedPolicy",
+    "RoundRobinPolicy",
     "SlotKVCache",
     "PagedKVCache",
     "PagePool",

@@ -715,6 +715,42 @@ class ServeEngine:
             self.metrics.observe_pages(self.pool.in_use)
         return self.scheduler.queue_depth + len(self.scheduler.running)
 
+    def step_prefill(self) -> int:
+        """The disaggregated prefill role's scheduler tick (docs/
+        serving.md, Fleet): expire deadlines and admit + prefill into
+        free slots exactly like :meth:`step`, but NEVER run a decode
+        dispatch — a prefilled request parks in its slot (first token
+        already sampled and recorded) until ``handoff_to`` moves its KV
+        to a decode engine.  Chunked mode only: the persistent loop's
+        deferred first-token fetch would ride a decode drain this role
+        never performs.  Returns unfinished requests (queued + parked).
+        """
+        if self._persistent:
+            raise RuntimeError(
+                "step_prefill requires decode_mode='chunked' — the "
+                "persistent loop defers first-token fetches to a decode "
+                "drain a prefill-role engine never runs"
+            )
+        now = time.monotonic()
+        for req in self.scheduler.expire_queued(now):
+            self._count_finish(req)
+        for req in list(self.scheduler.running):
+            if req.expired(now):
+                self._finish(req, "deadline", now)
+        gate = (
+            self._gate
+            if (self._draining or self.paged or self.hbm_budget is not None)
+            else None
+        )
+        for req, slot in self.scheduler.admit(now, gate=gate):
+            self._prefill_request(req, slot)
+        self.metrics.observe_gauges(
+            self.scheduler.queue_depth, self.cache.active_count
+        )
+        if self.paged:
+            self.metrics.observe_pages(self.pool.in_use)
+        return self.scheduler.queue_depth + len(self.scheduler.running)
+
     def run(
         self, requests: Iterable[Union[dict, Any]], *, max_new_tokens: int = 32
     ) -> List[RequestResult]:
@@ -857,40 +893,10 @@ class ServeEngine:
         n_coll = 0
         pages_moved = 0
         for req in running:
-            s_a = req.slot
-            pos_a = int(self.cache.pos[s_a])
-            pages_a = list(req.pages) if (self.paged and req.pages) else None
-            s_b = target.scheduler.adopt_running(req)  # sets req.slot
-            if self.paged:
-                new_pages = target.pool.alloc(len(pages_a))
-                w, c = self._copy_kv_pages(target, pages_a, new_pages)
-                target.cache.set_table(s_b, new_pages)
-                pages_moved += len(pages_a)
-            else:
-                w, c = self._copy_kv_slot(target, s_a, s_b)
+            s_a, s_b, w, c, moved = self._move_running(target, req)
             wire += w
             n_coll += c
-            # detach from the source AFTER the copy (retire validates the
-            # slot mapping, so it must see the request still attached —
-            # but adopt_running already rewrote req.slot, so point the
-            # validation at the source slot for the handoff)
-            req.slot = s_a
-            self.scheduler.retire(req)
-            req.slot = s_b
-            self.cache.retire(s_a)
-            if pages_a is not None:
-                self.pool.decref(pages_a)
-                req.pages = new_pages  # prefix-shared pages become private
-            target.cache.admit(s_b, pos_a)
-            for arr_a, arr_b in (
-                (self._last_tok, target._last_tok),
-                (self._temps, target._temps),
-                (self._seeds, target._seeds),
-                (self._ntok, target._ntok),
-                (self._budget, target._budget),
-                (self._hist, target._hist),
-            ):
-                arr_b[s_b] = arr_a[s_a]
+            pages_moved += moved
             req.record_event("migrated", ts=now, from_slot=s_a, to_slot=s_b)
             self.metrics.count("requests_migrated_out")
             target.metrics.count("requests_migrated_in")
@@ -916,6 +922,120 @@ class ServeEngine:
             "tp_to": target.tp,
             "slots_from": self.num_slots,
             "slots_to": target.num_slots,
+        }
+
+    def _move_running(self, target: "ServeEngine", req: Request):
+        """Move ONE running request's slot — KV state (slab row or page
+        chain) plus host sampling state — into ``target``, booking any
+        cross-sharding redistribution into the active comm audit.  The
+        shared mechanics of :meth:`migrate_to` (whole-engine drain) and
+        :meth:`handoff_to` (per-request prefill->decode disaggregation);
+        the caller has validated capacity.  Returns
+        ``(src_slot, dst_slot, wire_bytes, collectives, pages_moved)``.
+        """
+        s_a = req.slot
+        pos_a = int(self.cache.pos[s_a])
+        pages_a = list(req.pages) if (self.paged and req.pages) else None
+        s_b = target.scheduler.adopt_running(req)  # sets req.slot
+        if self.paged:
+            new_pages = target.pool.alloc(len(pages_a))
+            w, c = self._copy_kv_pages(target, pages_a, new_pages)
+            target.cache.set_table(s_b, new_pages)
+        else:
+            w, c = self._copy_kv_slot(target, s_a, s_b)
+        # detach from the source AFTER the copy (retire validates the
+        # slot mapping, so it must see the request still attached —
+        # but adopt_running already rewrote req.slot, so point the
+        # validation at the source slot for the handoff)
+        req.slot = s_a
+        self.scheduler.retire(req)
+        req.slot = s_b
+        self.cache.retire(s_a)
+        if pages_a is not None:
+            self.pool.decref(pages_a)
+            req.pages = new_pages  # prefix-shared pages become private
+        target.cache.admit(s_b, pos_a)
+        for arr_a, arr_b in (
+            (self._last_tok, target._last_tok),
+            (self._temps, target._temps),
+            (self._seeds, target._seeds),
+            (self._ntok, target._ntok),
+            (self._budget, target._budget),
+            (self._hist, target._hist),
+        ):
+            arr_b[s_b] = arr_a[s_a]
+        return s_a, s_b, w, c, len(pages_a) if pages_a is not None else 0
+
+    def handoff_to(self, target: "ServeEngine", req: Request) -> dict:
+        """Hand ONE prefilled running request — KV pages (or slab row)
+        and host sampling state — to ``target``, the DistServe-style
+        prefill->decode disaggregation step (docs/serving.md, Fleet).
+
+        Unlike :meth:`migrate_to` this moves a single request between
+        two LIVE engines: the source keeps admitting/prefilling (its
+        prefix index and remaining slots untouched) and the target keeps
+        decoding.  The KV move is the same explicit head-axis
+        redistribution, priced by the ``obs/comm.py`` ring model and
+        booked into the active comm audit; same-sharded engines move
+        pages for free (group 1 — no collective, no wire).  The greedy
+        stream continues bit-identically on the target: the handoff
+        decides WHERE the request decodes, never what it decodes.
+        Returns ``{"from_slot", "to_slot", "wire_bytes", "collectives",
+        "pages_moved"}``.
+        """
+        if target is self:
+            raise ValueError("cannot hand a request off to its own engine")
+        if target._draining:
+            raise RuntimeError(
+                "handoff target is draining — hand off to a live engine"
+            )
+        if req.slot is None or not any(
+            r is req for r in self.scheduler.running
+        ):
+            raise ValueError(
+                f"request {req.rid} is not running on this engine"
+            )
+        if self.paged != target.paged:
+            raise RuntimeError(
+                "cannot hand off between slab and paged engines — KV "
+                "layouts are not interconvertible in place"
+            )
+        if self.max_len != target.max_len:
+            raise RuntimeError(
+                f"KV geometry mismatch: source max_len {self.max_len} "
+                f"!= target max_len {target.max_len}"
+            )
+        if self.paged and self.page_size != target.page_size:
+            raise RuntimeError(
+                f"page-size mismatch: source {self.page_size} != "
+                f"target {target.page_size}"
+            )
+        if target.scheduler.free_slot_count < 1:
+            raise RuntimeError(
+                f"handoff target has no free slot for request {req.rid}"
+            )
+        if self.paged and len(req.pages or ()) > target.pool.free_count:
+            raise RuntimeError(
+                f"request {req.rid} holds {len(req.pages or ())} KV "
+                f"page(s) but the target pool has only "
+                f"{target.pool.free_count} free"
+            )
+        now = time.monotonic()
+        s_a, s_b, wire, n_coll, pages_moved = self._move_running(target, req)
+        req.record_event(
+            "handoff", ts=now, from_slot=s_a, to_slot=s_b, wire_bytes=wire
+        )
+        self.metrics.count("requests_handed_off")
+        self.metrics.count("handoff_pages_moved", pages_moved)
+        self.metrics.count("handoff_wire_bytes", wire)
+        self.metrics.count("handoff_collectives", n_coll)
+        target.metrics.count("requests_handed_in")
+        return {
+            "from_slot": s_a,
+            "to_slot": s_b,
+            "wire_bytes": int(wire),
+            "collectives": int(n_coll),
+            "pages_moved": int(pages_moved),
         }
 
     @staticmethod
@@ -1629,6 +1749,9 @@ class ServeEngine:
             )
         if self.pool.free_count < need_new:
             self.pool.decref(hit)
+            # the page-pressure rejection signal the fleet router polls
+            # (one tick per refused admit, like admissions_rejected_hbm)
+            self.metrics.count("admissions_rejected_pages")
             return False
         req.pages = hit + self.pool.alloc(need_new)
         req.prefix_len = len(hit) * ps

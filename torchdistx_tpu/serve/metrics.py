@@ -123,10 +123,21 @@ class ServeMetrics:
     ``admissions_rejected_hbm`` (admission ticks the HBM capacity
     planner refused because the projected peak exceeded
     ``ServeEngine(hbm_budget=...)``; the page gate alone would have
-    admitted).
-    Gauges: ``queue_depth``, ``active_slots``; paged engines add
+    admitted) and ``admissions_rejected_pages`` (ticks the page gate
+    refused the FCFS head even after LRU eviction — the page-pressure
+    rejection signal the fleet router reads) — and the disaggregation
+    set (``ServeEngine.handoff_to``) —
+    ``requests_handed_off`` / ``requests_handed_in`` (prefill->decode
+    per-request KV handoffs, source/target side),
+    ``handoff_pages_moved``, and ``handoff_wire_bytes`` /
+    ``handoff_collectives`` (the ring-model cost of those moves, exact
+    against the comm audit like ``migration_wire_bytes``).
+    Gauges: ``queue_depth``, ``active_slots``, ``slots_free``
+    (``num_slots - active_slots``, published first-class for the fleet
+    router); paged engines add
     ``pages_in_use`` / ``pages_in_use_hwm`` (current and high-water
-    allocated pages) and ``num_pages``; persistent engines add
+    allocated pages), ``num_pages``, and ``pages_free`` (allocatable
+    headroom, scratch page excluded); persistent engines add
     ``ring_capacity`` and ``ring_occupancy_hwm`` (high-water loop
     iterations a single dispatch used — at the capacity it means rings
     are filling and requests span drains); speculative engines add the
@@ -202,9 +213,15 @@ class ServeMetrics:
             "pages_evicted": 0,
             "admissions_rejected_hbm": 0,
             "submits_rejected_draining": 0,
+            "admissions_rejected_pages": 0,
             "requests_migrated_out": 0,
             "requests_migrated_in": 0,
             "migration_wire_bytes": 0,
+            "requests_handed_off": 0,
+            "requests_handed_in": 0,
+            "handoff_pages_moved": 0,
+            "handoff_wire_bytes": 0,
+            "handoff_collectives": 0,
         }
         self.queue_depth = 0
         self.active_slots = 0
@@ -253,11 +270,18 @@ class ServeMetrics:
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
             "num_slots": self.num_slots,
+            # first-class headroom gauge (additive): the fleet router's
+            # load signal, published instead of making every consumer
+            # derive num_slots - active_slots
+            "slots_free": self.num_slots - self.active_slots,
         }
         if self.num_pages is not None:
             gauges["num_pages"] = self.num_pages
             gauges["pages_in_use"] = self.pages_in_use
             gauges["pages_in_use_hwm"] = self.pages_in_use_hwm
+            # allocatable headroom: capacity excludes the reserved
+            # scratch page (prefix_cache.PagePool.capacity)
+            gauges["pages_free"] = (self.num_pages - 1) - self.pages_in_use
         if self.ring_capacity is not None:
             gauges["ring_capacity"] = self.ring_capacity
             gauges["ring_occupancy_hwm"] = self.ring_occupancy_hwm

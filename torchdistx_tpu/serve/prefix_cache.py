@@ -183,6 +183,26 @@ class RadixPrefixIndex:
             children = node.children
         return pages
 
+    def match_len(self, prompt) -> int:
+        """Read-only probe: the TOKENS a :meth:`match` of ``prompt``
+        would serve from cache (full pages only, capped at
+        ``len(prompt) - 1`` like ``match``) — WITHOUT handing out pages,
+        taking references, or touching any node's recency.  This is the
+        fleet router's warmth signal (docs/serving.md, Fleet): every
+        replica can be polled per incoming request and the losers'
+        LRU/eviction state stays exactly as if the probe never happened.
+        """
+        n_full = (len(prompt) - 1) // self.page_size
+        matched = 0
+        children = self._children
+        for chunk in self._chunks(prompt, n_full):
+            node = children.get(chunk)
+            if node is None:
+                break
+            matched += 1
+            children = node.children
+        return matched * self.page_size
+
     def insert(self, tokens, pages: List[int], pool: PagePool) -> int:
         """Adopt a prefilled request's full-prompt page chain:
         ``tokens`` must be ``len(pages) * page_size`` ids and ``pages``
