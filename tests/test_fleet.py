@@ -165,6 +165,29 @@ class TestRouting:
         assert cold.scheduler.queue_depth == 1
         assert warm.scheduler.queue_depth == 0
 
+    def test_rejection_tiebreak_is_windowed(self):
+        # a replica gated once must not be disadvantaged in routing
+        # ties forever: the tie-break reads the rejection delta since
+        # the last fleet tick, not the lifetime counters
+        from torchdistx_tpu.serve.fleet import _load_key
+
+        fleet = ServeFleet(
+            [_engine(1, 2), _engine(1, 2)], policy="least-loaded"
+        )
+        a, b = fleet.replicas
+        assert _load_key(a) > _load_key(b)  # idle tie -> lowest rid
+        a.engine.metrics.count("admissions_rejected_pages", 3)
+        assert a.recent_rejections() == 3
+        assert _load_key(a) < _load_key(b)  # fresh rejections repel
+        fleet.step()  # the window rolls at the tick boundary
+        assert a.recent_rejections() == 0
+        assert _load_key(a) > _load_key(b)  # bias gone: tie -> rid
+        # an engine with pre-fleet gate history joins unpenalized
+        used = _engine(1, 2)
+        used.metrics.count("admissions_rejected_hbm", 7)
+        fleet.add(used)
+        assert fleet.replicas[-1].recent_rejections() == 0
+
     def test_round_robin_cycles_and_policy_objects_plug_in(self):
         engines = [_engine(1, 2) for _ in range(2)]
         fleet = ServeFleet(engines, policy=RoundRobinPolicy())
@@ -239,6 +262,39 @@ class TestFleetStreams:
         assert j["counters"]["requests_submitted"] == len(reqs)
         with pytest.raises(RuntimeError, match="draining"):
             victim.engine.submit(np.ones(4, np.int32), max_new_tokens=1)
+
+    def test_scatter_failure_readopts_every_unplaced_request(self):
+        """The zero-drop contract's failure path: when a queued request
+        fits no survivor, the scatter re-adopts it AND the whole
+        drained tail behind it into the victim's queue — nothing ends
+        up attached to no scheduler."""
+        victim = _engine(1, 2, paged=True, num_pages=32)
+        small = _engine(1, 2, paged=True, num_pages=4)  # 3 allocatable
+        fleet = ServeFleet([victim, small], policy="round-robin")
+        fits = np.arange(8, dtype=np.int32)
+        big = np.arange(16, dtype=np.int32)
+        # FCFS: [fits, big, fits] — big needs 4 pages, small holds 3
+        h_a = victim.submit(fits, max_new_tokens=8)
+        h_b = victim.submit(big, max_new_tokens=16)
+        h_c = victim.submit(fits + 1, max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="could absorb"):
+            fleet.remove(fleet.replicas[0].rid)
+        # the victim stays in rotation, drained, holding the failing
+        # request and the tail behind it in FCFS order; the request
+        # placed before the failure stays on the survivor
+        assert len(fleet.replicas) == 2
+        assert victim._draining
+        assert [r.rid for r in victim.scheduler.queued] == [
+            h_b.rid, h_c.rid
+        ]
+        assert [r.rid for r in small.scheduler.queued] == [h_a.rid]
+        assert victim.metrics.counters["requests_migrated_out"] == 1
+        assert small.metrics.counters["requests_migrated_in"] == 1
+        # the re-homed request's handle resolves on the survivor
+        for _ in range(12):
+            fleet.step()
+        assert h_a.done()
+        assert not h_b.done() and not h_c.done()  # parked, not dropped
 
     def test_add_warms_into_rotation(self):
         fleet = ServeFleet([_engine(1, 2)], policy="round-robin")
@@ -325,6 +381,37 @@ class TestDisaggregated:
         assert pre.metrics.counters["handoff_pages_moved"] > 0
         # source pool holds only what its radix index still caches
         assert pre.pool.in_use == len(pre.prefix_index)
+
+    def test_backpressure_parks_then_places(self):
+        # one decode slot, two requests: the second prefill parks under
+        # back-pressure and hands off once the first finishes — streams
+        # still bit-identical to a co-located engine
+        reqs = [
+            dict(prompt=p, max_new_tokens=4)
+            for p in _shared_prefix_prompts(19, 2)
+        ]
+        ref = _engine(1, 2).run(reqs)
+        pre, dec = _engine(1, 2), _engine(1, 1)
+        fleet = ServeFleet([pre, dec], disaggregate=True)
+        out = fleet.run(reqs)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o.tokens, r.tokens)
+        assert pre.metrics.counters["requests_handed_off"] == 2
+        # the single decode slot serialized the handoffs across ticks
+        handoffs = [e for e in fleet.events if e[0] == "handoff"]
+        assert len(handoffs) == 2 and handoffs[0][1] < handoffs[1][1]
+
+    def test_never_fitting_handoff_raises_instead_of_spinning(self):
+        # a prefilled page chain larger than every decode pool's TOTAL
+        # capacity can never be handed off: step() must raise, not park
+        # the request forever while run()'s while-loop spins
+        pre = _engine(1, 2, paged=True, num_pages=32)
+        dec = _engine(1, 2, paged=True, num_pages=4)  # 3 allocatable
+        fleet = ServeFleet([pre, dec], disaggregate=True)
+        prompt = np.arange(24, dtype=np.int32)  # + 8 new = 4 pages
+        fleet.submit(prompt, max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="can never be handed"):
+            fleet.step()
 
     def test_disagg_validation(self):
         with pytest.raises(ValueError, match="at least two"):

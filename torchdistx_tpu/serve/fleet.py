@@ -15,7 +15,8 @@ shared-prefix workloads cluster.  Ties break on live load signals the
 stack already emits: ``slots_free``/``pages_free`` occupancy gauges,
 queue depth, the ``capacity_plan`` fit verdict, and the
 ``admissions_rejected_hbm`` / ``admissions_rejected_pages`` rejection
-counters.  A warm replica that is page- or HBM-gated is skipped — cache
+counters as per-tick windowed deltas (one early gating never biases
+ties for the rest of the process).  A warm replica that is page- or HBM-gated is skipped — cache
 affinity must never route a request into an admission stall when a cold
 replica has headroom.  :class:`LeastLoadedPolicy` and
 :class:`RoundRobinPolicy` make the A/B testable (``bench_serve.py
@@ -93,15 +94,18 @@ def replica_signals(engine: ServeEngine) -> dict:
 def _load_key(rep: "_Replica") -> tuple:
     """Headroom ordering (higher = roomier), deterministic: capacity-plan
     fit first (a gated replica only wins when everyone is gated), then
-    free slots net of queue, free pages, fewest recent rejections, and
-    finally lowest replica id so ties never flap."""
+    free slots net of queue, free pages, fewest recent rejections
+    (``_Replica.recent_rejections`` — gate refusals since the last
+    fleet tick, a rolling window, NOT the lifetime
+    ``admissions_rejected_*`` totals), and finally lowest replica id so
+    ties never flap."""
     s = replica_signals(rep.engine)
     pages = s["pages_free"] if s["pages_free"] is not None else float("inf")
     return (
         0 if s["hbm_fits"] is False else 1,
         s["slots_free"] - s["queue_depth"],
         pages,
-        -(s["rejected_hbm"] + s["rejected_pages"]),
+        -rep.recent_rejections(),
         -rep.rid,
     )
 
@@ -178,13 +182,33 @@ class AffinityPolicy:
 
 
 class _Replica:
-    __slots__ = ("rid", "engine", "role", "routed")
+    __slots__ = ("rid", "engine", "role", "routed", "_rej_seen")
 
     def __init__(self, rid: int, engine: ServeEngine, role: str):
         self.rid = rid
         self.engine = engine
         self.role = role
         self.routed = 0  # requests this router sent here
+        # rejection-counter snapshot for the windowed tie-break: taken
+        # at construction (an engine's pre-fleet history never biases
+        # routing) and rolled forward at every fleet tick
+        self._rej_seen = self._rej_total()
+
+    def _rej_total(self) -> int:
+        c = self.engine.metrics.counters
+        return (
+            c["admissions_rejected_hbm"] + c["admissions_rejected_pages"]
+        )
+
+    def recent_rejections(self) -> int:
+        """Gate rejections since the last fleet tick — the windowed
+        delta ``_load_key`` ties break on.  Lifetime totals would let
+        one early gating disadvantage a replica in routing ties for
+        the rest of the process."""
+        return self._rej_total() - self._rej_seen
+
+    def snapshot_rejections(self) -> None:
+        self._rej_seen = self._rej_total()
 
 
 class ServeFleet:
@@ -381,8 +405,12 @@ class ServeFleet:
         ``step_prefill`` tick, finished prefills hand their KV to decode
         replicas (``handoff_to``; a request that cannot be placed this
         tick stays parked and retries next tick — back-pressure, never a
-        drop), then decode replicas take their decode ``step()``.
-        Returns total unfinished requests across the fleet."""
+        drop — but one that could NEVER be placed raises instead of
+        spinning, see ``_check_ever_placeable``), then decode replicas
+        take their decode ``step()``.  Returns total unfinished
+        requests across the fleet."""
+        for rep in self._replicas:
+            rep.snapshot_rejections()  # roll the tie-break window
         unfinished = 0
         if self.disaggregate:
             for rep in self._by_role("prefill"):
@@ -408,6 +436,10 @@ class ServeFleet:
             for req in parked:
                 tgt = self._pick_decode_target(req, decodes)
                 if tgt is None:
+                    # transient pressure (busy slots/pages) parks and
+                    # retries; a request no decode replica could EVER
+                    # hold must fail loudly instead
+                    self._check_ever_placeable(req, decodes)
                     continue  # no decode headroom: retry next tick
                 info = rep.engine.handoff_to(tgt.engine, req)
                 self.events.append(
@@ -431,6 +463,42 @@ class ServeFleet:
             )
         ]
         return max(ok, key=_load_key) if ok else None
+
+    @staticmethod
+    def _check_ever_placeable(
+        req: Request, decodes: List[_Replica]
+    ) -> None:
+        """Parking is for transient pressure only.  ``_check_compat``
+        pins KV geometry at build time but not pool capacity, so a
+        prefilled request whose page chain exceeds every decode pool's
+        TOTAL capacity — or a fleet whose decode replicas are all
+        draining — would otherwise park forever and spin ``run()``'s
+        ``while step()`` loop with no error.  Raises on never-fits;
+        returns silently when some live decode replica could hold the
+        request once its slots/pages free up."""
+        live = [
+            d
+            for d in decodes
+            if not d.engine._draining and d.engine.num_slots > 0
+        ]
+        if not live:
+            raise RuntimeError(
+                f"prefilled request {req.rid} can never be handed off: "
+                "every decode replica is draining — add a decode "
+                "replica before draining the last one"
+            )
+        need = len(req.pages or ())
+        if all(
+            d.engine.paged and need > d.engine.pool.capacity
+            for d in live
+        ):
+            cap = max(d.engine.pool.capacity for d in live)
+            raise RuntimeError(
+                f"prefilled request {req.rid} holds {need} KV page(s) "
+                f"but the largest decode pool allocates only {cap} — "
+                "it can never be handed off; size decode pools for the "
+                "prefill role's admission footprint"
+            )
 
     def run(
         self,
@@ -514,8 +582,10 @@ class ServeFleet:
         the same comm audit and ``migration_*`` counters as a
         whole-engine ``migrate_to``.  Raises mid-way if some request
         fits nowhere — already-moved requests stay safely on their new
-        engines and the rest stay on the (still drained, still in
-        rotation) victim; nothing is ever dropped."""
+        engines, and EVERY un-placed request (the failing one plus the
+        whole drained tail behind it) goes back into the (still
+        drained, still in rotation) victim's queue, FCFS intact;
+        nothing is ever dropped."""
         src = rep.engine
         now = time.monotonic()
         wire = n_coll = pages_moved = 0
@@ -559,12 +629,16 @@ class ServeFleet:
             )
             src.metrics.count("requests_migrated_out")
             dst.engine.metrics.count("requests_migrated_in")
+            # booked per move (not once at the end) so counters stay
+            # equal to the comm audit even when the queue loop below
+            # raises after some KV has already moved
+            src.metrics.count("migration_wire_bytes", w)
             wire += w
             n_coll += c
             pages_moved += moved
             dest_rids.append(dst.rid)
         queued = src.scheduler.drain_queue()
-        for req in queued:
+        for i, req in enumerate(queued):
             cands = [
                 s
                 for s in survivors
@@ -577,8 +651,12 @@ class ServeFleet:
                 )
             ]
             if not cands:
-                # hand it back to the victim's queue so nothing is lost
-                src.scheduler.adopt_queued(req)
+                # zero-drop failure path: the failing request AND the
+                # whole un-placed tail behind it go back to the
+                # victim's queue (FCFS intact) — only queued[:i] was
+                # re-homed, so re-adopting queued[i:] loses nothing
+                for back in queued[i:]:
+                    src.scheduler.adopt_queued(back)
                 raise RuntimeError(
                     f"queued request {req.rid} fits no survivor "
                     "(bucket/page capacity)"
@@ -591,7 +669,6 @@ class ServeFleet:
             dest_rids.append(dst.rid)
         if src.paged and src.prefix_index is not None:
             src.prefix_index.evict(src.pool, src.pool.capacity)
-        src.metrics.count("migration_wire_bytes", wire)
         summary = {
             "migrated_running": n_running,
             "migrated_queued": len(queued),
