@@ -189,6 +189,24 @@ def _parse_args():
         "redistribution pinned closed-form against the comm audit",
     )
     ap.add_argument(
+        "--slo",
+        default=None,
+        metavar="SPEC",
+        help="path to a JSON SloSpec (obs/slo.py): every fleet phase "
+        "evaluates it over the fleet's finished requests and embeds "
+        "the tdx-slo-v1 report as the phase's 'slo' block (the routing "
+        "A/B embeds one report per policy — the SLO-attainment axis of "
+        "the affinity-vs-RR verdict); a breached evaluation lands a "
+        "named slo_burn flight event",
+    )
+    ap.add_argument(
+        "--slo-strict",
+        action="store_true",
+        help="with --slo: a breached report (or a burning window) is a "
+        "phase error and the run exits nonzero — the nightly "
+        "injected-burn leg's contract",
+    )
+    ap.add_argument(
         "--artifact",
         default=None,
         help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
@@ -313,6 +331,20 @@ def _phase_summary(rec: dict) -> dict:
         out["comm_wire_bytes"] = sum(
             (comm.get("bytes_by_axis") or {}).values()
         )
+    slo = rec.get("slo") or {}
+    if "schema" in slo:  # one report per phase
+        out["slo_attainment"] = (slo.get("attainment") or {}).get(
+            "overall"
+        )
+        out["slo_breached"] = slo.get("breached")
+        out["slo_burn_state"] = (slo.get("burn") or {}).get("state")
+    elif slo:  # the routing A/B carries one report per policy
+        for pol, r in sorted(slo.items()):
+            if isinstance(r, dict) and "schema" in r:
+                out[f"slo_attainment_{pol}"] = (
+                    r.get("attainment") or {}
+                ).get("overall")
+                out[f"slo_breached_{pol}"] = r.get("breached")
     return out
 
 
@@ -528,11 +560,13 @@ def _supervise(args) -> None:
         for name, p in sorted(record["phases"].items())
         if "error" in p
     ] or (["no phase ran"] if not record["phases"] else [])
-    if failed and os.environ.get("TDX_SERVE_STRICT"):
+    if failed and (os.environ.get("TDX_SERVE_STRICT") or args.slo_strict):
         # CI smoke mode: the record stays parseable on stdout either way,
         # but a phase error must FAIL the step — without this, the
         # degraded-record contract would let a fully broken fused-decode
-        # path keep a green nightly
+        # path keep a green nightly.  --slo-strict opts into the same
+        # contract even without TDX_SERVE_STRICT (the injected-burn leg
+        # must exit nonzero on its own)
         print(f"bench_serve: failed phases: {failed}", file=sys.stderr)
         sys.exit(1)
 
@@ -1428,40 +1462,96 @@ def _child_migrate(args) -> None:
     print(json.dumps(record))
 
 
-def _dump_obs_fleet(record: dict, fleet, tag: str) -> None:
+def _slo_spec(args):
+    """The committed ``--slo`` spec, parsed per use (cheap; children are
+    one-shot processes).  None without the flag."""
+    if not getattr(args, "slo", None):
+        return None
+    from torchdistx_tpu.obs.slo import SloSpec
+
+    return SloSpec.from_json(args.slo)
+
+
+def _eval_slo(args, requests, policy=None):
+    """Evaluate the ``--slo`` spec over finished requests into a
+    ``tdx-slo-v1`` report (obs/slo.py) — a breached evaluation also
+    lands a named ``slo_burn`` flight event in the global recorder.
+    None without ``--slo``."""
+    spec = _slo_spec(args)
+    if spec is None:
+        return None
+    from torchdistx_tpu.obs.slo import evaluate_slo
+
+    return evaluate_slo(spec, requests, policy=policy)
+
+
+def _maybe_slo_error(args, record: dict) -> None:
+    """``--slo-strict``: a breached report (or a burning window — the
+    same condition that fires the flight event) becomes the phase
+    ``error``, which the parent's strict path turns into a nonzero
+    exit.  A phase already in error keeps its original cause."""
+    if not getattr(args, "slo_strict", False) or "error" in record:
+        return
+    slo = record.get("slo") or {}
+    reports = (
+        [slo]
+        if "schema" in slo
+        else [v for v in slo.values() if isinstance(v, dict) and "schema" in v]
+    )
+    bad = [
+        r
+        for r in reports
+        if r.get("breached") or (r.get("burn") or {}).get("state") != "ok"
+    ]
+    if bad:
+        detail = "; ".join(
+            f"{(r.get('spec') or {}).get('name', '?')}"
+            f"[{r.get('policy') or '-'}]: attainment="
+            f"{(r.get('attainment') or {}).get('overall')} "
+            f"target={(r.get('attainment') or {}).get('target')} "
+            f"state={(r.get('burn') or {}).get('state')} "
+            f"axes={r.get('breached_axes')}"
+            for r in bad
+        )
+        record["error"] = f"SLO breached under --slo-strict: {detail}"
+
+
+def _dump_obs_fleet(record: dict, fleet, tag: str, slo_spec=None) -> None:
     """``_dump_obs`` for a whole fleet: ONE scrape surface — the
     exposition renders the fleet collector (replica-summed
     ``tdx_serve_*_total`` counters, so ``check_obs_artifacts`` validates
     them against the embedded aggregate ``metrics`` exactly as for a
-    single engine, plus per-replica ``tdx_fleet_*`` gauges) — and the
-    Perfetto trace comes from the replica holding the most finished
-    requests (every replica shares the process tracer, so the spans are
-    fleet-wide; the lifecycle tracks are that replica's)."""
+    single engine, plus per-replica ``tdx_fleet_*`` gauges and latency
+    quantile summaries, plus — with ``--slo`` — the ``tdx_slo_*``
+    projection) — and ONE merged Perfetto trace
+    (``fleet.dump_trace``): per-replica process tracks with every
+    request's route/queued/prefill/handoff/decode spans flow-linked on
+    its ``trace_id``, retired replicas included."""
     out_dir = os.environ.get("TDX_SERVE_TRACE_DIR")
     if not out_dir:
         return
     from torchdistx_tpu import obs
 
     os.makedirs(out_dir, exist_ok=True)
-    rep = max(
-        fleet.replicas, key=lambda r: len(r.engine.finished_requests())
-    )
     trace_path = os.path.join(out_dir, f"{tag}_trace.json")
-    rep.engine.dump_trace(trace_path)
-    finished = [
-        r
-        for rp in fleet.replicas
-        for r in rp.engine.finished_requests()
-    ]
+    fleet.dump_trace(trace_path)
+    finished = fleet.finished_requests()
     record["trace_path"] = trace_path
     record["trace_summary"] = {
         "requests": len(finished),
         "lifecycle_events": sum(len(r.events) for r in finished),
         "tracer_spans": len(obs.get_tracer().events()),
     }
+    rep = max(
+        fleet.replicas, key=lambda r: len(r.engine.finished_requests())
+    )
     registry = obs.MetricsRegistry()
     registry.register_collector(fleet.collector())
     registry.register_collector(rep.engine.cost_book.collector())
+    if slo_spec is not None:
+        registry.register_collector(
+            obs.slo_collector(slo_spec, fleet), obj=fleet
+        )
     prom_path = os.path.join(out_dir, f"{tag}_metrics.prom")
     with open(prom_path, "w") as f:
         f.write(registry.render())
@@ -1595,6 +1685,20 @@ def _child_fleet(args) -> None:
         # the affinity fleet's aggregate is the phase metrics: its
         # counters (hit/lookup tokens included) are the pinned rows
         record["metrics"] = fleet_aff.metrics_json()
+        # the SLO-attainment axis of the A/B: one tdx-slo-v1 report per
+        # policy, each over that fleet's own finished-request history
+        slo_aff = _eval_slo(
+            args, fleet_aff.finished_requests(), policy="affinity"
+        )
+        if slo_aff is not None:
+            record["slo"] = {
+                "affinity": slo_aff,
+                "round_robin": _eval_slo(
+                    args,
+                    fleet_rr.finished_requests(),
+                    policy="round_robin",
+                ),
+            }
         busiest = max(
             fleet_aff.replicas,
             key=lambda r: len(r.engine.finished_requests()),
@@ -1614,7 +1718,8 @@ def _child_fleet(args) -> None:
                 f"affinity prefix_hit_rate {aff['hit_rate']} does not "
                 f"strictly beat round-robin {rr['hit_rate']}"
             )
-        _dump_obs_fleet(record, fleet_aff, "fleet")
+        _maybe_slo_error(args, record)
+        _dump_obs_fleet(record, fleet_aff, "fleet", slo_spec=_slo_spec(args))
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -1700,6 +1805,9 @@ def _child_fleet_drain(args) -> None:
         # scrape surface is monotonic), so migration counters are
         # pinnable straight off the embedded metrics
         record["metrics"] = fleet.metrics_json()
+        slo_rep = _eval_slo(args, fleet.finished_requests())
+        if slo_rep is not None:
+            record["slo"] = slo_rep
         busiest = max(
             fleet.replicas,
             key=lambda r: len(r.engine.finished_requests()),
@@ -1722,7 +1830,10 @@ def _child_fleet_drain(args) -> None:
                 "the victim held nothing by remove() time — the leg "
                 "pinned no redistribution"
             )
-        _dump_obs_fleet(record, fleet, "fleet_drain")
+        _maybe_slo_error(args, record)
+        _dump_obs_fleet(
+            record, fleet, "fleet_drain", slo_spec=_slo_spec(args)
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -1804,6 +1915,9 @@ def _child_fleet_disagg(args) -> None:
         expect = n_req * len(pre.cache.kv) * 2 * (unit * (g - 1) // g)
         record["handoff_wire_bytes_expected"] = expect
         record["metrics"] = fleet.metrics_json()
+        slo_rep = _eval_slo(args, fleet.finished_requests())
+        if slo_rep is not None:
+            record["slo"] = slo_rep
         c = record["metrics"]["counters"]
         _embed_cost(record, dec)
         if not streams_equal:
@@ -1827,7 +1941,10 @@ def _child_fleet_disagg(args) -> None:
                 f"comm audit wire {int(prof.wire_bytes('all_gather', 'tp'))} "
                 f"disagrees with the closed form {expect}"
             )
-        _dump_obs_fleet(record, fleet, "fleet_disagg")
+        _maybe_slo_error(args, record)
+        _dump_obs_fleet(
+            record, fleet, "fleet_disagg", slo_spec=_slo_spec(args)
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))

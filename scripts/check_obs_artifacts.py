@@ -36,6 +36,19 @@ Usage:
     cards — numeric flops/bytes, peak source NAMED — and a bench.py
     record's ``extra.train_cost_card`` is checked the same way; the
     cost-observatory half of the nightly gate)
+  python scripts/check_obs_artifacts.py --slo BENCH_SERVE_CPU_FLEET.json
+    (SLO-observatory validation: every non-error fleet phase must embed
+    a schema-valid ``tdx-slo-v1`` block — spec echoed, attainment in
+    [0, 1], burn windows ordered (``obs.slo.validate_slo_report``) —
+    and every phase trace dump must satisfy Perfetto flow-event
+    referential integrity: each flow id resolves to BOTH endpoints
+    (one ``ph:"s"`` open and one ``ph:"f"`` close, opened before
+    closed), so every cross-replica request chain is stitched, never
+    dangling)
+  Flight validation accepts --expect-slo-burn alongside
+  --expect-rollback: the record must then contain an ``slo_burn``
+  entry naming the breached objective (the injected-burn CI leg's
+  gate).
 """
 
 from __future__ import annotations
@@ -111,7 +124,12 @@ def check_prom(path: str, metrics_json: dict, errors: list) -> int:
     return len(samples)
 
 
-def check_flight(path: str, errors: list, expect_rollback: bool = False) -> int:
+def check_flight(
+    path: str,
+    errors: list,
+    expect_rollback: bool = False,
+    expect_slo_burn: bool = False,
+) -> int:
     errs = validate_flight_jsonl(path)
     errors.extend(errs)
     if errs:
@@ -123,6 +141,18 @@ def check_flight(path: str, errors: list, expect_rollback: bool = False) -> int:
             errors.extend(
                 f"{path}: {e}" for e in validate_comm_profile(rec["comm"])
             )
+    if expect_slo_burn:
+        burns = [r for r in records if r.get("kind") == "slo_burn"]
+        if not burns:
+            errors.append(f"{path}: no slo_burn entry in flight record")
+        for r in burns:
+            if not r.get("slo") or r.get("state") not in (
+                "ok", "warn", "page"
+            ):
+                errors.append(
+                    f"{path}: slo_burn entry lacks slo name/state: "
+                    f"{r!r:.200}"
+                )
     if expect_rollback:
         rollbacks = [r for r in records if r.get("kind") == "rollback"]
         if not rollbacks:
@@ -140,8 +170,12 @@ def check_flight(path: str, errors: list, expect_rollback: bool = False) -> int:
 
 def _check_flight_main(argv: list) -> None:
     expect_rollback = "--expect-rollback" in argv
+    expect_slo_burn = "--expect-slo-burn" in argv
     unknown = [
-        a for a in argv if a.startswith("--") and a != "--expect-rollback"
+        a
+        for a in argv
+        if a.startswith("--")
+        and a not in ("--expect-rollback", "--expect-slo-burn")
     ]
     if unknown:
         # a typoed flag must NOT silently weaken the gate (e.g.
@@ -152,7 +186,12 @@ def _check_flight_main(argv: list) -> None:
         raise SystemExit(__doc__)
     errors: list = []
     for p in paths:
-        n = check_flight(p, errors, expect_rollback=expect_rollback)
+        n = check_flight(
+            p,
+            errors,
+            expect_rollback=expect_rollback,
+            expect_slo_burn=expect_slo_burn,
+        )
         print(f"flight {p}: {n} records")
     if errors:
         for e in errors:
@@ -228,6 +267,99 @@ def _check_cost_main(paths: list) -> None:
     print(f"cost cards OK ({checked} card(s), {len(paths)} file(s))")
 
 
+def check_flow_integrity(path: str, errors: list) -> int:
+    """Perfetto flow-event referential integrity for one trace dump:
+    every flow ``id`` must resolve to BOTH endpoints — at least one
+    ``ph:"s"`` open and one ``ph:"f"`` close — with the open no later
+    than the close.  A dangling flow means a request chain lost one of
+    its replicas in the merge."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: unreadable trace JSON: {e}")
+        return 0
+    flows: dict = {}
+    for ev in doc.get("traceEvents") or []:
+        if not isinstance(ev, dict) or ev.get("ph") not in ("s", "t", "f"):
+            continue
+        fid = ev.get("id")
+        if fid is None:
+            errors.append(f"{path}: flow event without id: {ev!r:.120}")
+            continue
+        flows.setdefault(fid, {"s": [], "t": [], "f": []})[ev["ph"]].append(
+            ev.get("ts")
+        )
+    for fid, phs in sorted(flows.items(), key=lambda kv: str(kv[0])):
+        if not phs["s"]:
+            errors.append(f"{path}: flow {fid} has no start endpoint (s)")
+        if not phs["f"]:
+            errors.append(f"{path}: flow {fid} has no finish endpoint (f)")
+        if phs["s"] and phs["f"] and min(phs["s"]) > max(phs["f"]):
+            errors.append(
+                f"{path}: flow {fid} closes before it opens "
+                f"(s at {min(phs['s'])}, f at {max(phs['f'])})"
+            )
+    return len(flows)
+
+
+def _check_slo_main(paths: list) -> None:
+    from torchdistx_tpu.obs.slo import validate_slo_report
+
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    n_reports = n_flows = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable record: {e}")
+            continue
+        for name, phase in (record.get("phases") or {}).items():
+            if not isinstance(phase, dict) or "error" in phase:
+                continue
+            slo = phase.get("slo")
+            if isinstance(slo, dict):
+                # one report, or a dict of per-policy reports (the
+                # affinity-vs-round-robin A/B embeds both)
+                reports = (
+                    {"": slo}
+                    if "schema" in slo
+                    else {
+                        k: v
+                        for k, v in slo.items()
+                        if isinstance(v, dict) and "schema" in v
+                    }
+                )
+                if not reports:
+                    errors.append(
+                        f"{path}: phase {name} slo block holds no "
+                        "tdx-slo-v1 report"
+                    )
+                for key, rep in sorted(reports.items()):
+                    tag = f"{name}[{key}]" if key else name
+                    errors.extend(
+                        f"{path}: phase {tag}: {e}"
+                        for e in validate_slo_report(rep)
+                    )
+                    n_reports += 1
+            if "trace_path" in phase:
+                n_flows += check_flow_integrity(phase["trace_path"], errors)
+        print(f"slo {path}: {n_reports} report(s), {n_flows} flow(s)")
+    if n_reports == 0:
+        errors.append(
+            "no tdx-slo-v1 block found in any phase — was the bench run "
+            "with --slo <spec>?"
+        )
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"slo artifacts OK ({n_reports} report(s), {n_flows} flow(s))")
+
+
 def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--flight":
         _check_flight_main(sys.argv[2:])
@@ -237,6 +369,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--cost":
         _check_cost_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--slo":
+        _check_slo_main(sys.argv[2:])
         return
     if len(sys.argv) != 2:
         raise SystemExit(__doc__)

@@ -188,6 +188,94 @@ class TestRouting:
         fleet.add(used)
         assert fleet.replicas[-1].recent_rejections() == 0
 
+    def test_routed_event_records_candidate_scoring(self):
+        """PR 14 satellite: the router's decision is never discarded —
+        the affinity pick leaves a ``("routed", ...)`` in the request's
+        own lifecycle events carrying the full candidate scoring the
+        policy saw (per-replica match_len, headroom tie-break values,
+        named skip reasons), mirrored into ``fleet.events`` with the
+        trace id."""
+        engines = [_engine(1, 2, paged=True) for _ in range(3)]
+        warm = engines[1]
+        prompts = _shared_prefix_prompts(3, 3)
+        warm.run([dict(prompt=prompts[0], max_new_tokens=2)])
+
+        fleet = ServeFleet(engines, policy="affinity")
+        warm_rid = fleet.replicas[1].rid
+        h = fleet.submit(prompts[1], max_new_tokens=2)
+        assert h.trace_id is not None
+        name, ts, data = h._request.events[-1]
+        assert name == "routed"
+        assert data["replica"] == warm_rid
+        assert data["policy"] == "affinity"
+        by_rid = {c["replica"]: c for c in data["candidates"]}
+        assert sorted(by_rid) == [r.rid for r in fleet.replicas]
+        assert by_rid[warm_rid]["match_len"] == 16
+        assert all(
+            c["match_len"] == 0
+            for rid, c in by_rid.items()
+            if rid != warm_rid
+        )
+        for c in by_rid.values():
+            # the _load_key tuple, JSON-able (no Inf), 5 components
+            assert isinstance(c["headroom"], list)
+            assert len(c["headroom"]) == 5
+            assert c["skip"] is None
+        # the fleet event mirrors the request's record + the trace id
+        ev_name, ev_ts, ev = fleet.events[-1]
+        assert ev_name == "routed" and ev_ts == ts
+        assert ev["trace_id"] == h.trace_id
+        assert ev["candidates"] == data["candidates"]
+
+    def test_page_gate_skip_and_tiebreak_values_recorded(self):
+        """The page-gated warm replica shows up in the scoring with
+        skip="pages" AND its own ``route_skipped`` lifecycle event; the
+        recorded headroom keys order the winner first among admittable
+        candidates."""
+        warm = _engine(1, 2, paged=True, num_pages=4)  # 3 allocatable
+        cold = _engine(1, 2, paged=True, num_pages=32)
+        prompts = _shared_prefix_prompts(4, 2, prefix_len=8, tail_len=8)
+        warm.run([dict(prompt=prompts[0][:9], max_new_tokens=2)])
+
+        fleet = ServeFleet([warm, cold], policy="affinity")
+        h = fleet.submit(prompts[1], max_new_tokens=16)
+        events = h._request.events
+        (routed,) = [e for e in events if e[0] == "routed"]
+        (skip,) = [e for e in events if e[0] == "route_skipped"]
+        assert skip[2] == {"rid": fleet.replicas[0].rid, "why": "pages"}
+        assert skip[1] == routed[1]  # one decision, one timestamp
+        by_rid = {c["replica"]: c for c in routed[2]["candidates"]}
+        assert by_rid[fleet.replicas[0].rid]["skip"] == "pages"
+        assert by_rid[fleet.replicas[0].rid]["match_len"] == 8  # warm!
+        assert by_rid[fleet.replicas[1].rid]["skip"] is None
+        assert routed[2]["replica"] == fleet.replicas[1].rid
+        # headroom is comparable as recorded: the admitted replica's
+        # key beats the gated one's on free pages (index 2)
+        hr_warm = by_rid[fleet.replicas[0].rid]["headroom"]
+        hr_cold = by_rid[fleet.replicas[1].rid]["headroom"]
+        assert hr_cold[2] > hr_warm[2]
+
+    def test_drain_skip_recorded(self):
+        """A draining replica never reaches the policy, but the record
+        still answers "why not replica 0": scoring covers it with
+        skip="draining"."""
+        fleet = ServeFleet(
+            [_engine(1, 2), _engine(1, 2)], policy="round-robin"
+        )
+        fleet.replicas[0].engine._draining = True
+        h = fleet.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+        (skip,) = [
+            e for e in h._request.events if e[0] == "route_skipped"
+        ]
+        assert skip[2] == {
+            "rid": fleet.replicas[0].rid,
+            "why": "draining",
+        }
+        (routed,) = [e for e in h._request.events if e[0] == "routed"]
+        by_rid = {c["replica"]: c for c in routed[2]["candidates"]}
+        assert by_rid[fleet.replicas[0].rid]["skip"] == "draining"
+        assert routed[2]["replica"] == fleet.replicas[1].rid
+
     def test_round_robin_cycles_and_policy_objects_plug_in(self):
         engines = [_engine(1, 2) for _ in range(2)]
         fleet = ServeFleet(engines, policy=RoundRobinPolicy())

@@ -1,0 +1,328 @@
+"""Cross-replica request tracing (ISSUE 14 tentpole).
+
+The pinned contract, on the 8-device CPU mesh:
+
+- **Spans tile bitwise**: ``obs.trace.fleet_request_spans`` returns a
+  telescoping chain — consecutive spans share their boundary float
+  VERBATIM, the first starts on ``submitted_at``, the last ends on
+  ``finished_at`` — so the per-span durations sum EXACTLY (as reals,
+  pinned via ``fractions.Fraction`` over the float boundaries) to the
+  e2e aggregate, across replicas, handoff gap included.
+- **Migration never breaks the tiling**: a mid-decode ``migrate_to``
+  segments the decode span at the migration boundary; the identity
+  survives ``fleet.remove``.
+- **One flow per request**: ``ServeFleet.dump_trace`` merges every
+  replica (retired ones included) into per-replica process tracks, each
+  request one flow-linked chain keyed on its process-unique
+  ``trace_id`` — every flow id resolves (an ``s`` and an ``f``
+  endpoint), the disaggregated chain crosses process tracks.
+- **The scrape surface answers "which replica is slow"**: the fleet
+  collector renders per-replica TTFT/TPOT/e2e quantile summaries.
+"""
+
+import json
+from fractions import Fraction
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import jax
+import torchdistx_tpu as tdx
+from torchdistx_tpu.models import Llama
+from torchdistx_tpu.obs import MetricsRegistry
+from torchdistx_tpu.obs.trace import (
+    _FLEET_PID_BASE,
+    fleet_request_spans,
+    fleet_request_trace_events,
+)
+from torchdistx_tpu.serve import ServeEngine, ServeFleet
+from torchdistx_tpu.serve.scheduler import Request
+
+
+def _llama():
+    tdx.manual_seed(0)
+    return Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+
+
+def _engine(tp, slots, paged=False, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", (32,))
+    kw.setdefault("decode_chunk", 2)
+    if paged:
+        kw.setdefault("page_size", 8)
+        kw.setdefault("num_pages", 32)
+    if tp > 1:
+        kw["mesh"] = Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+    return ServeEngine(_llama(), num_slots=slots, **kw)
+
+
+def _prompts(seed, n, prefix_len=16, tail_len=4):
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(0, 256, (prefix_len,)).astype(np.int32)
+    return [
+        np.concatenate(
+            [prefix, rs.randint(0, 256, (tail_len,)).astype(np.int32)]
+        )
+        for _ in range(n)
+    ]
+
+
+def _assert_tiles_bitwise(req, expect_names=None):
+    """The exactness pin: telescoping boundaries + Fraction-sum identity
+    (floats represent their values exactly; summing the exact per-span
+    differences must reproduce the exact e2e difference)."""
+    spans = fleet_request_spans(req)
+    assert spans, f"no spans for request {req.rid}"
+    assert spans[0][1] == req.submitted_at
+    assert spans[-1][2] == req.finished_at
+    for (_, _, t1), (_, t0, _) in zip(spans, spans[1:]):
+        assert t1 == t0  # shared boundary, verbatim float
+    total = sum(
+        (Fraction(t1) - Fraction(t0) for _, t0, t1 in spans),
+        Fraction(0),
+    )
+    assert total == Fraction(req.finished_at) - Fraction(req.submitted_at)
+    if expect_names is not None:
+        assert [s[0] for s in spans] == expect_names
+    return spans
+
+
+class TestSpanTiling:
+    def test_disagg_request_chain_is_bitwise_exact(self):
+        """The acceptance pin: a disaggregated request's spans — routed
+        on the prefill replica, finished on the decode replica — tile
+        ``[submitted_at, finished_at]`` exactly, handoff gap included."""
+        reqs = [
+            dict(prompt=p, max_new_tokens=m)
+            for p, m in zip(_prompts(21, 3), [4, 6, 4])
+        ]
+        pre, dec = _engine(1, 3), _engine(1, 3)
+        fleet = ServeFleet([pre, dec], disaggregate=True)
+        fleet.run(reqs)
+        finished = fleet.finished_requests()
+        assert len(finished) == len(reqs)
+        for req in finished:
+            spans = _assert_tiles_bitwise(req)
+            names = [s[0] for s in spans]
+            assert names[:3] == ["route", "queued", "prefill"]
+            assert "handoff" in names
+            assert names[-1] == "decode"
+        # trace ids are unique across the whole fleet and ordered
+        tids = [r.trace_id for r in finished]
+        assert len(set(tids)) == len(tids)
+        assert tids == sorted(tids)
+
+    def test_migrated_request_survives_remove(self):
+        """A mid-decode ``fleet.remove`` migration segments the decode
+        span at the boundary — the identity still holds, and the fleet's
+        merged history (retired replica included) still carries every
+        request."""
+        reqs = [
+            dict(prompt=p, max_new_tokens=8) for p in _prompts(23, 4)
+        ]
+        fleet = ServeFleet(
+            [_engine(1, 2) for _ in range(3)], policy="round-robin"
+        )
+        handles = [fleet.submit(**r) for r in reqs]
+        fleet.step()  # everyone admitted and mid-stream
+        victim = fleet.replicas[0]
+        assert victim.engine.scheduler.running
+        fleet.remove(victim.rid)
+        while fleet.step():
+            pass
+        assert all(h.done() for h in handles)
+        finished = fleet.finished_requests()
+        assert len(finished) == len(reqs)
+        migrated = [
+            r
+            for r in finished
+            if any(
+                n == "migrated" and not (d or {}).get("queued")
+                for n, _, d in r.events
+            )
+        ]
+        assert migrated, "remove() migrated no running request"
+        for req in migrated:
+            spans = _assert_tiles_bitwise(req)
+            # the migration split the decode window into >= 2 segments
+            assert [s[0] for s in spans].count("decode") >= 2
+        for req in finished:
+            _assert_tiles_bitwise(req)
+
+    def test_expired_while_queued_chain_ends_at_queued(self):
+        req = Request(rid=0, prompt=np.arange(4, dtype=np.int32),
+                      max_new_tokens=2, trace_id=7)
+        req.submitted_at = 100.0
+        req.record_event("routed", ts=100.25, replica=0)
+        req.finished_at = 101.0
+        _assert_tiles_bitwise(req, ["route", "queued"])
+        # and without fleet context there is no route span at all
+        req.events.clear()
+        _assert_tiles_bitwise(req, ["queued"])
+
+
+class TestMergedTrace:
+    def test_dump_trace_flow_integrity_across_process_tracks(
+        self, tmp_path
+    ):
+        """The merged Perfetto export: every request is one flow whose
+        id resolves (one ``s``, one ``f``), the disaggregated chain
+        crosses from the prefill track to the decode track, and both
+        replicas render as named process rows."""
+        reqs = [
+            dict(prompt=p, max_new_tokens=4) for p in _prompts(25, 3)
+        ]
+        pre, dec = _engine(1, 3), _engine(1, 3)
+        fleet = ServeFleet(
+            [pre, dec], disaggregate=True, roles=["prefill", "decode"]
+        )
+        fleet.run(reqs)
+        path = tmp_path / "fleet_trace.json"
+        fleet.dump_trace(str(path))
+        doc = json.loads(path.read_text())
+        evs = doc["traceEvents"]
+        pre_pid = _FLEET_PID_BASE + fleet.replicas[0].rid
+        dec_pid = _FLEET_PID_BASE + fleet.replicas[1].rid
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert names[pre_pid].endswith("(prefill)")
+        assert names[dec_pid].endswith("(decode)")
+        for req in fleet.finished_requests():
+            flow = [
+                e
+                for e in evs
+                if e.get("cat") == "req_flow"
+                and e.get("id") == req.trace_id
+            ]
+            phs = [e["ph"] for e in flow]
+            assert phs.count("s") == 1 and phs.count("f") == 1
+            assert phs[0] == "s" and phs[-1] == "f"
+            spans = [
+                e
+                for e in evs
+                if e.get("cat") == "request"
+                and e.get("tid") == req.trace_id
+            ]
+            # routed on the prefill track, finished on the decode track
+            assert {e["pid"] for e in spans} == {pre_pid, dec_pid}
+            by_name = {e["name"]: e for e in spans}
+            assert by_name["prefill"]["pid"] == pre_pid
+            assert by_name["decode"]["pid"] == dec_pid
+            # the flow endpoints live where their spans live
+            assert flow[0]["pid"] == pre_pid
+            assert flow[-1]["pid"] == dec_pid
+        # the script-side referential-integrity check agrees
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "check_obs_artifacts",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts",
+                "check_obs_artifacts.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        errors = []
+        mod.check_flow_integrity(str(path), errors)
+        assert errors == []
+
+    def test_single_span_chain_still_resolves(self):
+        req = Request(rid=3, prompt=np.arange(4, dtype=np.int32),
+                      max_new_tokens=2, trace_id=11)
+        req.submitted_at = 5.0
+        req.finished_at = 6.0
+        evs = fleet_request_trace_events([(0, "serve", req)])
+        flow = [e for e in evs if e.get("cat") == "req_flow"]
+        assert [e["ph"] for e in flow] == ["s", "f"]
+        assert flow[1]["bp"] == "e"
+
+    def test_dedup_and_trace_id_ordering(self):
+        """The same request arriving via two paths (live + retired)
+        renders once; entries order by trace_id."""
+        mk = lambda rid, tid: Request(
+            rid=rid, prompt=np.arange(4, dtype=np.int32),
+            max_new_tokens=2, trace_id=tid,
+        )
+        a, b = mk(0, 9), mk(0, 8)  # rids collide across replicas
+        for r, t in ((a, 1.0), (b, 2.0)):
+            r.submitted_at = t
+            r.finished_at = t + 1.0
+        evs = fleet_request_trace_events(
+            [(0, "serve", a), (1, "serve", b), (0, "serve", a)]
+        )
+        rows = [
+            e for e in evs if e.get("ph") == "X" and e["cat"] == "request"
+        ]
+        assert [e["args"]["trace_id"] for e in rows] == [8, 9]
+
+
+class TestFleetCollectorQuantiles:
+    def test_per_replica_latency_summaries(self):
+        reqs = [
+            dict(prompt=p, max_new_tokens=4) for p in _prompts(27, 4)
+        ]
+        fleet = ServeFleet(
+            [_engine(1, 2), _engine(1, 2)], policy="round-robin"
+        )
+        fleet.run(reqs)
+        registry = MetricsRegistry()
+        registry.register_collector(fleet.collector(), obj=fleet)
+        text = registry.render()
+        for hname in ("ttft_s", "tpot_s", "e2e_latency_s"):
+            for rep in fleet.replicas:
+                rid = str(rep.rid)
+                assert (
+                    f'tdx_fleet_{hname}{{quantile="0.5",replica="{rid}"}}'
+                    in text
+                )
+                assert (
+                    f'tdx_fleet_{hname}{{quantile="0.95",replica="{rid}"}}'
+                    in text
+                )
+                assert f'tdx_fleet_{hname}_count{{replica="{rid}"}}' in text
+        # the quantile values agree with the engine histograms' own
+        # nearest-rank estimator
+        from torchdistx_tpu.obs.metrics import parse_prometheus
+
+        parsed = parse_prometheus(text)
+        rep0 = fleet.replicas[0]
+        want = rep0.engine.metrics.ttft_s.quantile(0.5)
+        got = parsed["samples"][
+            ("tdx_fleet_ttft_s", (("quantile", "0.5"), ("replica", "0")))
+        ]
+        assert got == want
+
+
+@pytest.mark.slow
+class TestSpanTilingGridSlow:
+    @pytest.mark.parametrize("paged", [False, True])
+    @pytest.mark.parametrize("policy", ["affinity", "round-robin"])
+    def test_fleet_grid_every_request_tiles(self, policy, paged):
+        """The exhaustive sibling of the fast tiling pins: 3 replicas x
+        {policy} x {slab, paged} over a 9-request shared-prefix stream
+        with online arrival — every finished request tiles bitwise."""
+        prompts = _prompts(29, 9)
+        fleet = ServeFleet(
+            [_engine(1, 2, paged=paged) for _ in range(3)],
+            policy=policy,
+        )
+        handles = []
+        for i, p in enumerate(prompts):
+            handles.append(
+                fleet.submit(p, max_new_tokens=4 + (i % 3) * 2)
+            )
+            fleet.step()
+        while fleet.step():
+            pass
+        assert all(h.done() for h in handles)
+        finished = fleet.finished_requests()
+        assert len(finished) == len(prompts)
+        for req in finished:
+            _assert_tiles_bitwise(req)

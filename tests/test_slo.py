@@ -1,0 +1,409 @@
+"""SLO observatory (ISSUE 14): spec validation, the deterministic /
+timing-derived report split, burn-rate alert states, flight events, the
+Prometheus projection, the schema validator, and the ledger's exact
+counter pins."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchdistx_tpu.obs.slo import (
+    SLO_SCHEMA,
+    SloSpec,
+    evaluate_slo,
+    slo_collector,
+    validate_slo_report,
+)
+from torchdistx_tpu.serve.scheduler import Request
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+
+
+def _req(
+    tid,
+    *,
+    submitted=100.0,
+    admitted=100.1,
+    first=100.5,
+    finished=101.0,
+    n_tokens=4,
+    reason="length",
+):
+    r = Request(
+        rid=tid,
+        prompt=np.arange(4, dtype=np.int32),
+        max_new_tokens=n_tokens,
+        trace_id=tid,
+    )
+    r.submitted_at = submitted
+    r.admitted_at = admitted
+    r.first_token_at = first
+    r.finished_at = finished
+    r.generated = list(range(n_tokens))
+    r.finish_reason = reason
+    return r
+
+
+class TestSloSpec:
+    def test_roundtrip_and_file_loading(self, tmp_path):
+        spec = SloSpec(
+            name="gold",
+            ttft_p95_s=0.5,
+            e2e_p95_s=2.0,
+            deadline_s=3.0,
+            attainment_target=0.99,
+            windows_s=(60.0, 300.0),
+        )
+        assert SloSpec.from_json(spec.to_json()) == spec
+        p = tmp_path / "spec.json"
+        p.write_text(json.dumps(spec.to_json()))
+        assert SloSpec.from_json(str(p)) == spec
+
+    def test_committed_specs_parse(self):
+        # the two specs the nightly runs under must always load
+        for fname in ("slo_fleet_smoke.json", "slo_burn_inject.json"):
+            path = os.path.join(
+                os.path.dirname(SCRIPTS), "expectations", fname
+            )
+            spec = SloSpec.from_json(path)
+            assert spec.attainment_target == 1.0
+        assert SloSpec.from_json(
+            os.path.join(
+                os.path.dirname(SCRIPTS),
+                "expectations",
+                "slo_burn_inject.json",
+            )
+        ).deadline_s == pytest.approx(1e-6)
+
+    def test_validation_rejects_bad_specs(self):
+        with pytest.raises(ValueError, match="ttft_p95_s"):
+            SloSpec(ttft_p95_s=0.0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            SloSpec(deadline_s=-1.0)
+        with pytest.raises(ValueError, match="attainment_target"):
+            SloSpec(attainment_target=1.5)
+        with pytest.raises(ValueError, match="ascending"):
+            SloSpec(windows_s=(300.0, 60.0))
+        with pytest.raises(ValueError, match="ascending"):
+            SloSpec(windows_s=(60.0, 60.0))
+        with pytest.raises(ValueError, match="at least one"):
+            SloSpec(windows_s=())
+        with pytest.raises(ValueError, match="burn_threshold"):
+            SloSpec(burn_threshold=0.0)
+        with pytest.raises(ValueError, match="unknown"):
+            SloSpec.from_json({"name": "x", "latency_target": 1.0})
+
+
+class TestEvaluate:
+    def test_counters_are_the_deterministic_half(self):
+        reqs = [
+            _req(1),
+            _req(2, finished=103.0, reason="deadline"),  # truncated
+            _req(3, finished=102.0, reason="cache_full"),  # truncated
+            _req(4, finished=109.0),  # slow but untruncated
+        ]
+        spec = SloSpec(name="t", deadline_s=5.0, windows_s=(1000.0,))
+        rep = evaluate_slo(spec, reqs, now=110.0, flight=False)
+        assert rep["schema"] == SLO_SCHEMA
+        assert rep["counters"] == {
+            "requests_total": 4,
+            "requests_attained": 1,
+            "requests_violated": 3,
+            "requests_truncated_deadline": 1,
+            "requests_truncated_cache_full": 1,
+            "tokens_attained": 4,
+        }
+        assert rep["attainment"]["overall"] == 0.25
+        assert rep["attainment"]["ok"] is False
+        assert rep["breached"] is True
+        # goodput rates derive from the same counters over the span
+        span = 109.0 - 100.0
+        assert rep["goodput"]["span_s"] == span
+        assert rep["goodput"]["requests_attained_per_s"] == 1 / span
+        assert rep["goodput"]["tokens_attained_per_s"] == 4 / span
+
+    def test_percentile_targets_and_breached_axes(self):
+        # 10 requests, ttft 0.5s each, e2e 1.0s each
+        reqs = [_req(i) for i in range(10)]
+        spec = SloSpec(name="p", ttft_p95_s=0.6, e2e_p95_s=0.9)
+        rep = evaluate_slo(spec, reqs, now=200.0, flight=False)
+        assert rep["percentiles"]["ttft_p95_s"]["ok"] is True
+        assert rep["percentiles"]["ttft_p95_s"]["measured"] == 0.5
+        assert rep["percentiles"]["e2e_p95_s"]["ok"] is False
+        assert rep["breached_axes"] == ["e2e_p95_s"]
+        assert rep["breached"] is True
+        # axes with no target still report measured values
+        assert rep["percentiles"]["tpot_p50_s"]["target"] is None
+
+    def test_empty_history_is_indeterminate_not_breached(self):
+        rep = evaluate_slo(SloSpec(), [], now=0.0, flight=False)
+        assert rep["counters"]["requests_total"] == 0
+        assert rep["attainment"]["overall"] is None
+        assert rep["breached"] is False
+        assert rep["burn"]["state"] == "ok"
+
+    def test_burn_states_escalate_per_window(self):
+        # violations confined to the last 60s: the fast window burns
+        # (warn), the slow window has enough old good requests to stay
+        # under the budget -> not page
+        spec = SloSpec(
+            name="b",
+            deadline_s=2.0,
+            attainment_target=0.5,
+            windows_s=(60.0, 1000.0),
+        )
+        now = 1000.0
+        old_good = [
+            _req(i, submitted=500.0 + i, finished=501.0 + i)
+            for i in range(8)
+        ]
+        fresh_bad = [
+            _req(10 + i, submitted=960.0 + i, finished=970.0 + i)
+            for i in range(4)
+        ]
+        rep = evaluate_slo(
+            spec, old_good + fresh_bad, now=now, flight=False
+        )
+        fast, slow = rep["burn"]["windows"]
+        assert fast["window_s"] == 60.0 and fast["violations"] == 4
+        assert fast["burning"] is True and fast["burn_rate"] == 2.0
+        assert slow["violations"] == 4 and slow["requests"] == 12
+        assert slow["burning"] is False
+        assert rep["burn"]["state"] == "warn"
+        # every window burning escalates to page
+        rep2 = evaluate_slo(spec, fresh_bad, now=now, flight=False)
+        assert rep2["burn"]["state"] == "page"
+        # zero budget (100% target): any violation burns, rate is None
+        spec3 = SloSpec(name="z", deadline_s=2.0, windows_s=(60.0,))
+        rep3 = evaluate_slo(spec3, fresh_bad, now=now, flight=False)
+        (w3,) = rep3["burn"]["windows"]
+        assert w3["burn_rate"] is None and w3["burning"] is True
+        assert rep3["burn"]["state"] == "page"
+
+    def test_breach_lands_a_named_flight_event(self):
+        class Flight:
+            def __init__(self):
+                self.recs = []
+
+            def record(self, kind, **fields):
+                self.recs.append((kind, fields))
+
+        fl = Flight()
+        spec = SloSpec(name="paged-slo", deadline_s=0.1, windows_s=(60.0,))
+        evaluate_slo(
+            spec,
+            [_req(1, finished=105.0)],
+            now=105.0,
+            policy="affinity",
+            flight=fl,
+        )
+        assert len(fl.recs) == 1
+        kind, fields = fl.recs[0]
+        assert kind == "slo_burn"
+        assert fields["slo"] == "paged-slo"
+        assert fields["policy"] == "affinity"
+        assert fields["state"] == "page"
+        assert fields["attainment"] == 0.0
+        assert fields["requests_violated"] == 1
+        # a healthy evaluation records nothing
+        ok_spec = SloSpec(name="ok", deadline_s=100.0, windows_s=(60.0,))
+        evaluate_slo(ok_spec, [_req(2)], now=101.0, flight=fl)
+        assert len(fl.recs) == 1
+
+
+class TestCollector:
+    def test_projection_renders_next_to_fleet_gauges(self):
+        from torchdistx_tpu.obs import MetricsRegistry
+
+        class Source:
+            def __init__(self, reqs):
+                self._reqs = reqs
+
+            def finished_requests(self):
+                return self._reqs
+
+        src = Source([_req(1), _req(2, finished=109.0)])
+        spec = SloSpec(name="gold", deadline_s=5.0, windows_s=(60.0,))
+        registry = MetricsRegistry()
+        registry.register_collector(slo_collector(spec, src), obj=src)
+        text = registry.render()
+        assert 'tdx_slo_requests_total{slo="gold"} 2' in text
+        assert 'tdx_slo_requests_attained{slo="gold"} 1' in text
+        assert 'tdx_slo_attainment{slo="gold"} 0.5' in text
+        assert 'tdx_slo_breached{slo="gold"} 1' in text
+        assert 'tdx_slo_burn_state{slo="gold"}' in text
+        assert 'window="60.0"' in text
+        # weakref: a dead source renders no families and never crashes
+        del src
+        assert "tdx_slo_requests_total" not in registry.render()
+
+
+class TestValidator:
+    def _good(self):
+        spec = SloSpec(name="v", deadline_s=5.0, windows_s=(60.0, 300.0))
+        return evaluate_slo(spec, [_req(1)], now=102.0, flight=False)
+
+    def test_good_report_validates(self):
+        assert validate_slo_report(self._good()) == []
+
+    def test_corruptions_are_named(self):
+        rep = self._good()
+        rep["schema"] = "tdx-slo-v0"
+        assert any("schema" in e for e in validate_slo_report(rep))
+        rep = self._good()
+        rep["attainment"]["overall"] = 1.5
+        assert any("[0, 1]" in e for e in validate_slo_report(rep))
+        rep = self._good()
+        rep["counters"]["requests_attained"] = 7
+        assert any(
+            "attained + violated" in e for e in validate_slo_report(rep)
+        )
+        rep = self._good()
+        rep["burn"]["windows"] = list(reversed(rep["burn"]["windows"]))
+        assert any("ascending" in e for e in validate_slo_report(rep))
+        rep = self._good()
+        rep["burn"]["windows"] = rep["burn"]["windows"][:1]
+        assert any(
+            "do not match" in e for e in validate_slo_report(rep)
+        )
+        rep = self._good()
+        rep["spec"]["windows_s"] = [300.0, 60.0]
+        assert any("parse" in e for e in validate_slo_report(rep))
+        assert validate_slo_report([]) != []
+
+
+class TestLedgerIngest:
+    def test_slo_counters_become_exact_pins(self):
+        from torchdistx_tpu.obs.ledger import ingest_serve_record
+
+        spec = SloSpec(name="l", deadline_s=5.0, windows_s=(60.0,))
+        single = evaluate_slo(spec, [_req(1)], now=102.0, flight=False)
+        per_policy = {
+            "affinity": single,
+            "round_robin": evaluate_slo(
+                spec,
+                [_req(2), _req(3, finished=109.0)],
+                now=110.0,
+                flight=False,
+            ),
+        }
+        rows = ingest_serve_record(
+            {
+                "phases": {
+                    "fleet": {"slo": per_policy},
+                    "fleet_drain": {"slo": single},
+                }
+            },
+            run_id="r",
+            ts=1.0,
+        )
+        by_key = {
+            (r["fingerprint"], r["metric"]): r
+            for r in rows
+            if r["metric"].startswith("slo_")
+        }
+        k = ("phase=fleet", "slo_affinity_requests_total")
+        assert by_key[k]["value"] == 1
+        assert by_key[k]["metric_class"] == "counter"
+        assert by_key[
+            ("phase=fleet", "slo_round_robin_requests_violated")
+        ]["value"] == 1
+        assert by_key[
+            ("phase=fleet", "slo_round_robin_attainment")
+        ]["value"] == 0.5
+        assert by_key[
+            ("phase=fleet_drain", "slo_requests_attained")
+        ]["value"] == 1
+        # attainment is a ratio of two deterministic counters — it pins
+        # as a counter row, like prefix_hit_rate
+        assert all(
+            r["metric_class"] == "counter"
+            for r in rows
+            if r["metric"].startswith("slo_")
+        )
+
+
+class TestSloCLI:
+    def test_check_obs_artifacts_slo_mode(self, tmp_path):
+        script = os.path.join(SCRIPTS, "check_obs_artifacts.py")
+        spec = SloSpec(name="cli", deadline_s=5.0, windows_s=(60.0,))
+        rep = evaluate_slo(spec, [_req(1)], now=102.0, flight=False)
+        good = {"phases": {"fleet": {"slo": rep}}}
+        p_good = tmp_path / "good.json"
+        p_good.write_text(json.dumps(good))
+        out = subprocess.run(
+            [sys.executable, script, "--slo", str(p_good)],
+            capture_output=True,
+            text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        # a fleet record whose phases carry no slo block must FAIL —
+        # silence is not compliance
+        p_none = tmp_path / "none.json"
+        p_none.write_text(json.dumps({"phases": {"fleet": {}}}))
+        out = subprocess.run(
+            [sys.executable, script, "--slo", str(p_none)],
+            capture_output=True,
+            text=True,
+        )
+        assert out.returncode != 0
+        # corrupt attainment fails loudly
+        rep_bad = json.loads(json.dumps(rep))
+        rep_bad["attainment"]["overall"] = 2.0
+        p_bad = tmp_path / "bad.json"
+        p_bad.write_text(json.dumps({"phases": {"fleet": {"slo": rep_bad}}}))
+        out = subprocess.run(
+            [sys.executable, script, "--slo", str(p_bad)],
+            capture_output=True,
+            text=True,
+        )
+        assert out.returncode != 0
+        assert "[0, 1]" in (out.stderr + out.stdout)
+
+    def test_expect_slo_burn_requires_the_event(self, tmp_path):
+        script = os.path.join(SCRIPTS, "check_obs_artifacts.py")
+        burn = tmp_path / "flight.jsonl"
+        burn.write_text(
+            json.dumps(
+                {
+                    "kind": "slo_burn",
+                    "t": 1.0,
+                    "slo": "burn-inject",
+                    "state": "page",
+                }
+            )
+            + "\n"
+        )
+        out = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--flight",
+                "--expect-slo-burn",
+                str(burn),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        quiet = tmp_path / "quiet.jsonl"
+        quiet.write_text(json.dumps({"kind": "stall", "t": 1.0}) + "\n")
+        out = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--flight",
+                "--expect-slo-burn",
+                str(quiet),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert out.returncode != 0
+        assert "slo_burn" in (out.stderr + out.stdout)

@@ -55,6 +55,20 @@ built (docs/observability.md "Cost observatory & capacity planner"):
 - :mod:`~torchdistx_tpu.obs.watchdog` — dispatch-stall deadline timer
   that dumps the flight recorder naming the in-flight program and its
   cost card (the wedged-relay black box).
+
+PR 14 adds the *fleet SLO observatory* (docs/observability.md "Fleet
+tracing & SLO observatory"):
+
+- :mod:`~torchdistx_tpu.obs.slo` — declarative TTFT/TPOT/e2e/deadline
+  SLO specs evaluated over the engines' per-request histories into
+  ``tdx-slo-v1`` reports: deterministic attainment counters, goodput
+  under SLO, multi-window burn-rate alert states, a Prometheus
+  projection (:func:`slo_collector`), and ``slo_burn`` flight events.
+- cross-replica request tracing: :func:`fleet_request_spans` /
+  :func:`fleet_request_trace_events` tile each request's life into
+  route/queued/prefill/handoff/decode spans on the shared monotonic
+  timebase and stitch them with Perfetto flow events keyed on the
+  process-unique ``Request.trace_id`` (``ServeFleet.dump_trace``).
 """
 
 from .comm import CommProfile, comm_audit, record_collective
@@ -100,11 +114,20 @@ from .metrics import (
     start_metrics_server,
 )
 from .recompile import RecompileWatcher, recompile_scope, track_jit_cache
+from .slo import (
+    SLO_SCHEMA,
+    SloSpec,
+    evaluate_slo,
+    slo_collector,
+    validate_slo_report,
+)
 from .watchdog import DispatchWatchdog
 from .trace import (
     Tracer,
     disable_tracing,
     enable_tracing,
+    fleet_request_spans,
+    fleet_request_trace_events,
     get_tracer,
     request_trace_events,
 )
@@ -127,6 +150,13 @@ __all__ = [
     "enable_tracing",
     "disable_tracing",
     "request_trace_events",
+    "fleet_request_spans",
+    "fleet_request_trace_events",
+    "SLO_SCHEMA",
+    "SloSpec",
+    "evaluate_slo",
+    "slo_collector",
+    "validate_slo_report",
     "MetricFamily",
     "Counter",
     "Gauge",

@@ -409,6 +409,23 @@ def ingest_serve_record(record: dict, **kw) -> List[dict]:
             "prefill_calls_warm",
         ):
             row(k, phase.get(k), "counter")
+        # SLO observatory (obs.slo): the deterministic half of the
+        # tdx-slo-v1 block gates exactly — attainment COUNTS are integer
+        # counts of deterministic predicates (truncation/deadline splits
+        # on a deterministic workload), and overall attainment is their
+        # exact ratio, like prefix_hit_rate.  Measured percentiles,
+        # goodput rates, and burn rates are wall-clock and stay out.
+        slo = phase.get("slo") or {}
+        for rep_key, rep in (
+            [("", slo)]
+            if "counters" in slo
+            else [(f"{k}_", v) for k, v in sorted(slo.items())
+                  if isinstance(v, dict) and "counters" in v]
+        ):
+            for name, v in (rep.get("counters") or {}).items():
+                row(f"slo_{rep_key}{name}", v, "counter")
+            att = (rep.get("attainment") or {}).get("overall")
+            row(f"slo_{rep_key}attainment", att, "counter")
         # cost observatory (obs.cost): one counter row per deterministic
         # card field per program — XLA flop/byte counts are exact on a
         # fixed platform, so the gate pins them like host_syncs.  The

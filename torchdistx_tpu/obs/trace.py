@@ -33,6 +33,8 @@ __all__ = [
     "enable_tracing",
     "disable_tracing",
     "request_trace_events",
+    "fleet_request_spans",
+    "fleet_request_trace_events",
 ]
 
 
@@ -292,6 +294,271 @@ def request_trace_events(requests, name_prefix: str = "req") -> List[dict]:
                     "name": name,
                     "cat": "lifecycle",
                     "pid": _REQUEST_PID,
+                    "tid": tid,
+                    "ts": ts,
+                    "s": "t",
+                    **({"args": data} if data else {}),
+                }
+            )
+    return out
+
+
+# -- fleet (cross-replica) request tracing --------------------------------
+#
+# A fleet request's life spans MACHINES: router decision -> prefill
+# replica -> KV handoff -> decode replica -> (maybe) migration.  The
+# builders below generalize the single-engine lifecycle rows above: one
+# chrome-trace PROCESS per replica, one thread row per request keyed on
+# the process-unique ``Request.trace_id`` (rids collide across replicas),
+# and Perfetto FLOW events (ph s/t/f sharing ``id=trace_id``) stitching
+# the spans into one causal chain per request across replica tracks.
+
+_FLEET_PID_BASE = 10  # replica rid r renders as chrome pid 10 + r
+
+
+def fleet_request_spans(req, routed_ts: Optional[float] = None):
+    """The request's telescoping cross-replica span chain:
+    ``(name, t0, t1)`` triples in absolute monotonic seconds.
+
+    This is THE exactness primitive of the fleet tracing contract
+    (docs/observability.md): consecutive spans share their boundary
+    timestamp VERBATIM (span ``i`` ends on the exact float span ``i+1``
+    starts on), the first span starts on ``submitted_at`` and the last
+    ends on ``finished_at`` — so the chain tiles ``[submitted_at,
+    finished_at]`` with no gap and no overlap, and the span durations
+    sum *exactly* (as reals — pin it with ``fractions.Fraction`` over
+    the float boundaries, which represent their values exactly) to the
+    ``latency_s`` e2e aggregate, handoff gap included.  IEEE float
+    addition of the per-span ``t1 - t0`` differences would reintroduce
+    rounding; the identity lives in the shared boundaries.
+
+    Boundaries, in order (absent stages collapse out):
+
+    - ``route``: ``submitted_at`` -> the ``routed`` event's ts (router
+      decision latency; only requests submitted through a fleet have it)
+    - ``queued``: -> ``admitted_at`` (or ``finished_at`` for a request
+      that expired while queued — then the chain ends here)
+    - ``prefill``: -> ``first_token_at``
+    - ``handoff``: -> each disaggregated ``handoff`` event's ts (the
+      parked-for-a-decode-slot gap plus the wire move)
+    - ``decode``: -> ``finished_at``, segmented at any mid-decode
+      ``migrated`` event ts (each segment is its own ``decode`` span, so
+      a migration never breaks the tiling)
+    """
+    if routed_ts is None:
+        for name, ts, _ in getattr(req, "events", ()):
+            if name == "routed":
+                routed_ts = ts
+                break
+    spans = []
+    cursor = req.submitted_at
+    if routed_ts is not None:
+        spans.append(("route", cursor, routed_ts))
+        cursor = routed_ts
+    if req.admitted_at is None:
+        if req.finished_at is not None:  # expired while queued
+            spans.append(("queued", cursor, req.finished_at))
+        return spans
+    spans.append(("queued", cursor, req.admitted_at))
+    cursor = req.admitted_at
+    if req.first_token_at is None:
+        if req.finished_at is not None:  # expired before first token
+            spans.append(("prefill", cursor, req.finished_at))
+        return spans
+    spans.append(("prefill", cursor, req.first_token_at))
+    cursor = req.first_token_at
+    if req.finished_at is None:
+        return spans
+    # post-first-token boundaries: handoffs (disaggregation) and
+    # mid-decode migrations, in event order, clamped to the decode window
+    for name, ts, data in getattr(req, "events", ()):
+        if name == "handoff" and cursor <= ts <= req.finished_at:
+            spans.append(("handoff", cursor, ts))
+            cursor = ts
+        elif (
+            name == "migrated"
+            and not (data or {}).get("queued")
+            and cursor <= ts <= req.finished_at
+        ):
+            spans.append(("decode", cursor, ts))
+            cursor = ts
+    spans.append(("decode", cursor, req.finished_at))
+    return spans
+
+
+def fleet_request_trace_events(
+    finished, roles=None, name_prefix: str = "req"
+) -> List[dict]:
+    """Merged multi-replica request rows + flow events for
+    ``ServeFleet.dump_trace``.
+
+    ``finished`` is an iterable of ``(replica_rid, role, request)`` —
+    the replica each request FINISHED on (live rotation plus replicas
+    already retired by ``fleet.remove``).  ``roles`` optionally maps
+    additional replica rids (e.g. the prefill replica a disaggregated
+    request was ROUTED to, which never holds the finished request) to
+    their role string for the process-name metadata rows.
+
+    Span placement: everything up to the last cross-engine boundary
+    (the final ``handoff``/``migrated`` event) renders on the replica
+    the request was ROUTED to (from its ``routed`` lifecycle event);
+    the remainder on the replica it finished on.  Each request is one
+    flow: ``ph:"s"`` opens the chain on its first span, a ``ph:"t"``
+    step rides every intermediate span, ``ph:"f"`` (``bp:"e"``) closes
+    it on the last — all sharing ``id=trace_id``, which is what the
+    ``check_obs_artifacts.py --slo`` referential-integrity check
+    resolves end-to-end.  Timestamps stay absolute monotonic seconds;
+    pass the result to :meth:`Tracer.export` as ``extra_events``.
+    """
+    finished = list(finished)
+    role_of = dict(roles or {})
+    for rid, role, _req in finished:
+        role_of.setdefault(rid, role)
+
+    # deterministic request order (trace_id is process-unique); guard
+    # against the same request arriving via two paths
+    seen = set()
+    entries = []
+    for rid, role, req in finished:
+        key = id(req)
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append((rid, req))
+    entries.sort(
+        key=lambda e: (
+            e[1].trace_id if e[1].trace_id is not None else int(e[1].rid),
+        )
+    )
+
+    out: List[dict] = []
+    pids_named = set()
+
+    def ensure_pid(rid: int) -> int:
+        pid = _FLEET_PID_BASE + int(rid)
+        if rid not in pids_named:
+            pids_named.add(rid)
+            role = role_of.get(rid)
+            label = f"replica {rid}" + (f" ({role})" if role else "")
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "process_name",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": label},
+                }
+            )
+        return pid
+
+    for finish_rid, req in entries:
+        trace_id = (
+            int(req.trace_id)
+            if req.trace_id is not None
+            else int(req.rid) + 1
+        )
+        tid = trace_id  # unique per request across the whole process
+        routed_rid = finish_rid
+        for name, _ts, data in getattr(req, "events", ()):
+            if name == "routed" and data and "replica" in data:
+                routed_rid = int(data["replica"])
+                break
+        spans = fleet_request_spans(req)
+        if not spans:
+            continue
+        # spans strictly before the last cross-engine boundary happened
+        # on the routed replica; the rest on the finishing one.  The
+        # boundary index is the last span that ENDS on a handoff or
+        # mid-decode migration event.
+        cut = 0
+        boundary_ts = {
+            ts
+            for name, ts, data in getattr(req, "events", ())
+            if name == "handoff"
+            or (name == "migrated" and not (data or {}).get("queued"))
+        }
+        for i, (_name, _t0, t1) in enumerate(spans):
+            if t1 in boundary_ts:
+                cut = i + 1
+        pid_of_span = [
+            ensure_pid(routed_rid if i < cut else finish_rid)
+            for i in range(len(spans))
+        ]
+        for pid in sorted(set(pid_of_span)):
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"{name_prefix} {trace_id}"},
+                }
+            )
+        for i, (name, t0, t1) in enumerate(spans):
+            out.append(
+                {
+                    "ph": "X",
+                    "name": name,
+                    "cat": "request",
+                    "pid": pid_of_span[i],
+                    "tid": tid,
+                    "ts": t0,
+                    "dur": max(0.0, t1 - t0),
+                    "args": {
+                        "rid": int(req.rid),
+                        "trace_id": trace_id,
+                        "replica": pid_of_span[i] - _FLEET_PID_BASE,
+                    },
+                }
+            )
+        # the flow: s on the first span, t steps between, f on the last
+        for i, (name, t0, t1) in enumerate(spans):
+            ph = (
+                "s"
+                if i == 0
+                else ("f" if i == len(spans) - 1 else "t")
+            )
+            if len(spans) == 1:
+                # a one-span chain still needs both endpoints so every
+                # flow id resolves: open AND close on the same slice
+                out.append(
+                    {
+                        "ph": "s",
+                        "name": f"{name_prefix}_flow",
+                        "cat": "req_flow",
+                        "id": trace_id,
+                        "pid": pid_of_span[i],
+                        "tid": tid,
+                        "ts": t0,
+                    }
+                )
+                ph = "f"
+            ev = {
+                "ph": ph,
+                "name": f"{name_prefix}_flow",
+                "cat": "req_flow",
+                "id": trace_id,
+                "pid": pid_of_span[i],
+                "tid": tid,
+                "ts": t0,
+            }
+            if ph == "f":
+                ev["bp"] = "e"
+            out.append(ev)
+        # lifecycle instants ride the span that contains them (fall back
+        # to the finishing replica's track for out-of-window timestamps)
+        for name, ts, data in getattr(req, "events", ()):
+            pid = ensure_pid(finish_rid)
+            for i, (_n, t0, t1) in enumerate(spans):
+                if t0 <= ts <= t1:
+                    pid = pid_of_span[i]
+                    break
+            out.append(
+                {
+                    "ph": "i",
+                    "name": name,
+                    "cat": "lifecycle",
+                    "pid": pid,
                     "tid": tid,
                     "ts": ts,
                     "s": "t",

@@ -622,9 +622,15 @@ class ServeEngine:
         temperature: float = 0.0,
         seed: int = 0,
         deadline_s: Optional[float] = None,
+        trace_id: Optional[int] = None,
     ) -> RequestHandle:
         """Enqueue one request; returns immediately.  ``step()`` (or
-        ``run``) drives it to completion."""
+        ``run``) drives it to completion.  ``trace_id`` propagates an
+        existing fleet-scoped trace context (an external router's, say);
+        left None, the scheduler mints a process-unique one — either way
+        the id rides the request through ``handoff_to``/``migrate_to``
+        so a cross-replica trace merge keys on it, not on the
+        per-scheduler (colliding) rid."""
         if self._draining:
             # named refusal, not a silent queue-forever: a draining
             # engine will never admit again, so accepting the submit
@@ -680,6 +686,7 @@ class ServeEngine:
             # is already assigned
             seed=int(seed) & 0x7FFFFFFF,
             deadline_s=deadline_s,
+            trace_id=None if trace_id is None else int(trace_id),
         )
         self.scheduler.submit(req)
         self.metrics.count("requests_submitted")

@@ -110,25 +110,47 @@ def _load_key(rep: "_Replica") -> tuple:
     )
 
 
-def _admittable(rep: "_Replica", prompt, max_new_tokens: int) -> bool:
-    """Would this replica's admission gate plausibly take the request
-    without stalling?  A router-side heuristic mirroring the engine's
-    gate order (the gate itself stays the enforcement): HBM plan must
-    not already be over budget, and a paged replica must hold enough
-    free pages for the request's footprint net of its prefix hit."""
+def _skip_reason(rep: "_Replica", prompt, max_new_tokens: int):
+    """Why this replica's admission gate would plausibly stall the
+    request, or None when it would take it.  A router-side heuristic
+    mirroring the engine's gate order (the gate itself stays the
+    enforcement), with the refusal NAMED the way the engine's gate names
+    its lifecycle events: ``"draining"`` (will never admit again),
+    ``"hbm_budget"`` (capacity plan already over budget), or ``"pages"``
+    (not enough free pages for the footprint net of the prefix hit).
+    The name lands verbatim in the request's ``route_skipped`` lifecycle
+    events, so a trace answers "why NOT replica 2" as well as "why
+    replica 1"."""
     e = rep.engine
     if e._draining:
-        return False
+        return "draining"
     if e.hbm_budget is not None and e.memory_plan()["fits"] is False:
-        return False
+        return "hbm_budget"
     if e.paged and prompt is not None:
         ps = e.page_size
         need = -(-(len(prompt) + int(max_new_tokens)) // ps)
         if e.prefix_index is not None:
             need -= e.prefix_index.match_len(prompt) // ps
         if need > e.pool.free_count:
-            return False
-    return True
+            return "pages"
+    return None
+
+
+def _admittable(rep: "_Replica", prompt, max_new_tokens: int) -> bool:
+    """Would this replica's admission gate plausibly take the request
+    without stalling?  (``_skip_reason`` with the reason discarded.)"""
+    return _skip_reason(rep, prompt, max_new_tokens) is None
+
+
+def _json_key(key: tuple) -> list:
+    """``_load_key`` as JSON-able event data: the slab engines'
+    ``float("inf")`` pages sentinel becomes None (JSON has no Inf)."""
+    return [
+        None
+        if isinstance(k, float) and (k != k or abs(k) == float("inf"))
+        else k
+        for k in key
+    ]
 
 
 class RoundRobinPolicy:
@@ -290,6 +312,12 @@ class ServeFleet:
         # counter must never decrease, so a retired replica's totals
         # (its migrations out included) stay in the fleet aggregate
         self._retired_counters: dict = {}
+        # finished requests of removed replicas, as (replica_rid, role,
+        # request): remove() drops the _Replica (and with it the
+        # engine's _finished history), but a merged fleet trace must
+        # still show requests that FINISHED on a replica later scaled
+        # away — dump_trace() merges these like any live replica's
+        self._retired_finished: List[tuple] = []
 
     # -- rotation ---------------------------------------------------------
 
@@ -378,10 +406,37 @@ class ServeFleet:
     ) -> RequestHandle:
         """Route one request (policy decides the replica) and submit it
         there; the returned handle is engine-agnostic and stays valid
-        across handoffs and ``remove`` migrations."""
-        rep = self.policy.route(
-            prompt, max_new_tokens, self._route_candidates()
-        )
+        across handoffs and ``remove`` migrations.
+
+        The decision is never discarded: the full candidate scoring —
+        per-replica ``match_len``, the ``_load_key`` headroom tie-break
+        values, named skip reasons — is recorded BEFORE routing (probing
+        is read-only, so scoring first keeps the policy's view and the
+        record identical), then lands in the request's own lifecycle
+        events as one ``("routed", ...)`` with the scores plus a
+        ``("route_skipped", ...)`` per gated replica, and in
+        ``fleet.events``.  Scoring covers every role replica — draining
+        ones included, so the record answers "why not replica 2" even
+        for replicas the policy never sees — while the policy still
+        routes over the live candidates only."""
+        cands = self._route_candidates()
+        scored, skipped = [], []
+        for r in self._by_role("prefill" if self.disaggregate else "serve"):
+            why = _skip_reason(r, prompt, max_new_tokens)
+            idx = r.engine.prefix_index
+            scored.append(
+                {
+                    "replica": r.rid,
+                    "match_len": (
+                        int(idx.match_len(prompt)) if idx is not None else 0
+                    ),
+                    "headroom": _json_key(_load_key(r)),
+                    "skip": why,
+                }
+            )
+            if why is not None:
+                skipped.append((r.rid, why))
+        rep = self.policy.route(prompt, max_new_tokens, cands)
         handle = rep.engine.submit(
             prompt,
             max_new_tokens=max_new_tokens,
@@ -390,10 +445,22 @@ class ServeFleet:
             deadline_s=deadline_s,
         )
         rep.routed += 1
+        now = time.monotonic()
+        req = handle._request
+        policy = getattr(self.policy, "name", "custom")
+        for rid_skipped, why in skipped:
+            req.record_event(
+                "route_skipped", ts=now, rid=rid_skipped, why=why
+            )
+        req.record_event(
+            "routed", ts=now, replica=rep.rid, policy=policy,
+            candidates=scored,
+        )
         self.events.append(
-            ("routed", time.monotonic(),
-             {"rid": handle.rid, "replica": rep.rid,
-              "policy": getattr(self.policy, "name", "custom")})
+            ("routed", now,
+             {"rid": handle.rid, "trace_id": handle.trace_id,
+              "replica": rep.rid, "policy": policy,
+              "candidates": scored})
         )
         return handle
 
@@ -444,8 +511,8 @@ class ServeFleet:
                 info = rep.engine.handoff_to(tgt.engine, req)
                 self.events.append(
                     ("handoff", time.monotonic(),
-                     {"rid": req.rid, "from": rep.rid, "to": tgt.rid,
-                      **info})
+                     {"rid": req.rid, "trace_id": req.trace_id,
+                      "from": rep.rid, "to": tgt.rid, **info})
                 )
 
     @staticmethod
@@ -568,6 +635,10 @@ class ServeFleet:
                 ) from last_err
         for k, v in rep.engine.metrics.counters.items():
             self._retired_counters[k] = self._retired_counters.get(k, 0) + v
+        self._retired_finished.extend(
+            (rep.rid, rep.role, req)
+            for req in rep.engine.finished_requests()
+        )
         self._replicas.remove(rep)
         out = {**summary, "replica": rep.rid, "to": to}
         self.events.append(("remove", time.monotonic(), out))
@@ -714,6 +785,52 @@ class ServeFleet:
         )
         return rep.rid
 
+    # -- observability -----------------------------------------------------
+
+    def finished_requests(self) -> List[Request]:
+        """Every finished request across the fleet — live replicas plus
+        replicas already retired by :meth:`remove` — in trace-id order.
+        The per-request history surface the SLO engine (``obs/slo.py``)
+        evaluates."""
+        entries = [
+            req
+            for rep in self._replicas
+            for req in rep.engine.finished_requests()
+        ]
+        entries.extend(req for _rid, _role, req in self._retired_finished)
+        entries.sort(
+            key=lambda r: (
+                r.trace_id if r.trace_id is not None else int(r.rid)
+            )
+        )
+        return entries
+
+    def dump_trace(self, path: str) -> str:
+        """Export ONE merged Perfetto trace for the whole fleet: the
+        global tracer's host spans plus every replica's finished
+        requests — live rotation and replicas since retired by
+        :meth:`remove` — as per-replica process tracks on the shared
+        monotonic timebase, each request one flow-linked causal chain
+        (``route -> queued -> prefill -> handoff -> decode``) keyed on
+        its process-unique ``trace_id`` (``obs.trace.
+        fleet_request_trace_events``).  Open in ui.perfetto.dev; gate
+        with ``scripts/check_obs_artifacts.py --slo``."""
+        from ..obs.trace import fleet_request_trace_events, get_tracer
+
+        finished = []
+        roles = {}
+        for rep in self._replicas:
+            roles[rep.rid] = rep.role
+            for req in rep.engine.finished_requests():
+                finished.append((rep.rid, rep.role, req))
+        for rid, role, req in self._retired_finished:
+            roles.setdefault(rid, role)
+            finished.append((rid, role, req))
+        return get_tracer().export(
+            path,
+            extra_events=fleet_request_trace_events(finished, roles=roles),
+        )
+
     # -- metrics ----------------------------------------------------------
 
     def metrics_json(self) -> dict:
@@ -787,7 +904,12 @@ class ServeFleet:
         counters render as ``{serve_prefix}_<name>_total`` (a fleet of
         one is indistinguishable from a bare engine's exposition), and
         the per-replica occupancy/routing breakdown renders as
-        ``{prefix}_*`` gauges labeled ``replica="<rid>"``."""
+        ``{prefix}_*`` gauges labeled ``replica="<rid>"``, with each
+        replica's TTFT/TPOT/e2e latency histograms as per-replica
+        quantile summaries (``{prefix}_ttft_s{replica=,quantile=}``
+        plus ``_sum``/``_count``) — so "which replica is slow" is
+        answerable from the scrape surface alone, no artifact
+        digging."""
         import weakref
 
         from ..obs.metrics import MetricFamily
@@ -833,6 +955,28 @@ class ServeFleet:
             for r in j["fleet"]["replicas"]:
                 fam.add(r["requests_routed"], replica=str(r["replica"]))
             fams.append(fam)
+            # per-replica latency summaries: the same windowed-quantile
+            # rendering ServeMetrics.collector uses, labeled by replica
+            for hname in ("ttft_s", "tpot_s", "e2e_latency_s"):
+                fam = MetricFamily(f"{prefix}_{hname}", "summary")
+                any_sample = False
+                for rep in fleet._replicas:
+                    hist = getattr(rep.engine.metrics, hname)
+                    if hist.count == 0:
+                        continue
+                    rlabel = str(rep.rid)
+                    fam.add(
+                        hist.quantile(0.5), quantile="0.5", replica=rlabel
+                    )
+                    fam.add(
+                        hist.quantile(0.95), quantile="0.95",
+                        replica=rlabel,
+                    )
+                    fam.add(hist.total, "_sum", replica=rlabel)
+                    fam.add(hist.count, "_count", replica=rlabel)
+                    any_sample = True
+                if any_sample:
+                    fams.append(fam)
             return fams
 
         return collect

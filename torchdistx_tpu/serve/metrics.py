@@ -63,6 +63,15 @@ class Histogram:
         idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
         return xs[idx]
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Windowed quantile (see the class docstring for the window
+        semantics) — the public read the SLO engine (``obs/slo.py``)
+        and the fleet's per-replica latency summaries evaluate.  None
+        while the window is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        return self._quantile(float(q))
+
     def snapshot(self) -> Dict[str, Optional[float]]:
         return {
             "count": self.count,

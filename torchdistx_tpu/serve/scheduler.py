@@ -33,6 +33,15 @@ __all__ = ["Request", "RequestHandle", "RequestResult", "Scheduler"]
 
 _TRUNCATED_REASONS = ("deadline", "cache_full")
 
+# Fleet-scoped trace-context ids.  Every engine's scheduler mints rids
+# from its OWN counter, so rids collide across fleet replicas; trace ids
+# come from one process-wide stream instead, making them unique across
+# every engine in the process — the key ``ServeFleet.dump_trace()``
+# merges replicas on and the Perfetto flow-event id that stitches a
+# request's queued -> route -> prefill -> handoff -> decode -> finish
+# chain across engines (docs/observability.md).
+_TRACE_IDS = itertools.count(1)
+
 
 @dataclasses.dataclass
 class RequestResult:
@@ -65,6 +74,12 @@ class Request:
     temperature: float = 0.0
     seed: int = 0
     deadline_s: Optional[float] = None  # seconds from submit, wall clock
+    # fleet-scoped trace context: unique across every engine in the
+    # process (rids are per-scheduler and collide across replicas).
+    # Assigned at submit from the module's ``_TRACE_IDS`` stream unless
+    # the caller propagates an existing context; rides the request
+    # through handoff_to/migrate_to untouched.
+    trace_id: Optional[int] = None
     # -- lifecycle (owned by the scheduler/engine) -----------------------
     submitted_at: float = 0.0
     admitted_at: Optional[float] = None
@@ -148,6 +163,11 @@ class RequestHandle:
     def rid(self) -> int:
         return self._request.rid
 
+    @property
+    def trace_id(self) -> Optional[int]:
+        """Fleet-scoped trace context (process-unique, unlike rid)."""
+        return self._request.trace_id
+
     def done(self) -> bool:
         return self._request.finish_reason is not None
 
@@ -175,6 +195,8 @@ class Scheduler:
 
     def submit(self, request: Request) -> None:
         request.rid = next(self._rid)
+        if request.trace_id is None:
+            request.trace_id = next(_TRACE_IDS)
         request.submitted_at = time.monotonic()
         request.record_event("submit", ts=request.submitted_at)
         self._queue.append(request)
