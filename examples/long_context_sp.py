@@ -72,9 +72,12 @@ def main() -> None:
     #    the whole step is one shard_map
     from torchdistx_tpu.parallel.compat import shard_map
 
+    from torchdistx_tpu.parallel import collectives
+
     def loss_fn(p, tokens, labels):
         logits = functional_call(model, p, (tokens,))
-        return jax.lax.pmean(
+        # through the audit choke point, not raw lax.pmean (TDX103)
+        return collectives.all_mean(
             functional.cross_entropy(logits, labels), "sp"
         )
 

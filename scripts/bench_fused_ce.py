@@ -89,7 +89,7 @@ def main() -> None:
 
     for spec in args.shapes.split(","):
         n, d, v = (int(s) for s in spec.split("x"))
-        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)  # tdx-lint: disable=TDX102 -- fixed-seed bench input data, not parameter init
         x = jax.random.normal(ks[0], (n, d), jnp.bfloat16)
         w = jax.random.normal(ks[1], (v, d), jnp.bfloat16) * 0.1
         y = jax.random.randint(ks[2], (n,), 0, v)

@@ -45,6 +45,12 @@ Usage:
     (one ``ph:"s"`` open and one ``ph:"f"`` close, opened before
     closed), so every cross-replica request chain is stitched, never
     dangling)
+  python scripts/check_obs_artifacts.py --lint LINT_REPORT.json
+    (tdx-lint-v1 schema validation for a ``scripts/tdx_lint.py
+    --json-out``/``--update-baseline`` artifact — including the
+    committed ``expectations/static_analysis_baseline.json``; checks
+    field types, TDXnnn rule ids, severities, and that every recorded
+    suppression carries justification text)
   Flight validation accepts --expect-slo-burn alongside
   --expect-rollback: the record must then contain an ``slo_burn``
   entry naming the breached objective (the injected-burn CI leg's
@@ -360,6 +366,34 @@ def _check_slo_main(paths: list) -> None:
     print(f"slo artifacts OK ({n_reports} report(s), {n_flows} flow(s))")
 
 
+def _check_lint_main(paths: list) -> None:
+    from torchdistx_tpu.analysis import validate_lint_report
+
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{p}: unreadable lint report: {e}")
+            continue
+        errs = validate_lint_report(doc)
+        errors.extend(f"{p}: {e}" for e in errs)
+        if not errs:
+            print(
+                f"lint {p}: {len(doc['findings'])} finding(s), "
+                f"{len(doc['suppressions'])} suppression(s), "
+                f"{doc['files_scanned']} file(s) scanned"
+            )
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"lint reports OK ({len(paths)} file(s))")
+
+
 def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--flight":
         _check_flight_main(sys.argv[2:])
@@ -372,6 +406,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--slo":
         _check_slo_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--lint":
+        _check_lint_main(sys.argv[2:])
         return
     if len(sys.argv) != 2:
         raise SystemExit(__doc__)

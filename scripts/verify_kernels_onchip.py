@@ -178,7 +178,7 @@ def _run_fused_ce_case(name: str, spec: dict) -> dict:
     dtype = jnp.dtype(spec.get("dtype", "float32"))
     n, d, v = spec["n"], spec["d"], spec["v"]
     seed = zlib.crc32(name.encode())
-    k = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 3)
+    k = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 3)  # tdx-lint: disable=TDX102 -- name-derived verification inputs, stable across processes; not parameter init
     x = jax.random.normal(k[0], (n, d), dtype)
     w = jax.random.normal(k[1], (v, d), dtype) * 0.1
     y = jax.random.randint(k[2], (n,), 0, v)
@@ -233,7 +233,7 @@ def _run_case(name: str, spec: dict) -> dict:
     bidirectional = spec.get("bidirectional", False)
 
     seed = zlib.crc32(name.encode())  # stable across processes/runs
-    keys = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 6)
+    keys = jax.random.split(jax.random.PRNGKey(seed % (2**31)), 6)  # tdx-lint: disable=TDX102 -- name-derived verification inputs, stable across processes; not parameter init
     q = jax.random.normal(keys[0], (b, sq, hq, d), dtype)
     k = jax.random.normal(keys[1], (b, skv, hkv, d), dtype)
     v = jax.random.normal(keys[2], (b, skv, hkv, d), dtype)

@@ -350,6 +350,112 @@ class TestCommAuditGossip:
         )
 
 
+class TestCommAuditRing:
+    """Sequence-parallel attention traffic is booked (the TDX103 fix):
+    ring passes record n ppermute ops per rotating tensor (the length-n
+    scan executes every rotation, INCLUDING the final home-coming hop —
+    the audit books what runs, not the textbook n-1), Ulysses records
+    its four all-to-alls.  Payloads are exact per-device block bytes."""
+
+    def _qkv(self, b, s, h, d, seed=0):
+        rs = np.random.RandomState(seed)
+        return tuple(
+            jnp.asarray(rs.randn(b, s, h, d), jnp.float32) for _ in range(3)
+        )
+
+    def test_jnp_ring_forward_closed_form(self, mesh8):
+        from torchdistx_tpu.ops.attention import ring_attention
+
+        n = 8
+        b, s, h, d = 2, 64, 4, 16
+        q, k, v = self._qkv(b, s, h, d)
+        fn = jax.jit(
+            shard_map(
+                lambda q_, k_, v_: ring_attention(
+                    q_, k_, v_, axis="fsdp", causal=True
+                ),
+                mesh=mesh8,
+                in_specs=(P(None, "fsdp"),) * 3,
+                out_specs=P(None, "fsdp"),
+                check_vma=False,
+            )
+        )
+        with comm_audit() as prof:
+            fn(q, k, v)
+        # rotating carry: K block, V block, 4-byte block index
+        blk = b * (s // n) * h * d * F32
+        ring_bytes = n * (2 * blk + 4)
+        assert prof.ops("ppermute", "fsdp") == 3 * n
+        assert prof.payload_bytes("ppermute", "fsdp") == ring_bytes
+        # full-rotation ring hop: every device sends, wire ratio 1.0
+        assert prof.wire_bytes("ppermute", "fsdp") == ring_bytes
+        assert validate_comm_profile(prof.to_json()) == []
+
+        # cached program: the second call must record NOTHING
+        with comm_audit() as prof2:
+            fn(q, k, v)
+        assert not prof2
+
+    def test_flash_ring_backward_books_five_tensors(self, mesh8):
+        from torchdistx_tpu.ops.attention import ring_flash_attention
+
+        n = 8
+        b, s, h, d = 1, 64, 4, 8
+        q, k, v = self._qkv(b, s, h, d, seed=1)
+        ring = shard_map(
+            lambda q_, k_, v_: ring_flash_attention(
+                q_, k_, v_, axis="fsdp", causal=True, block_q=8, block_k=8
+            ),
+            mesh=mesh8,
+            in_specs=(P(None, "fsdp"),) * 3,
+            out_specs=P(None, "fsdp"),
+            check_vma=False,
+        )
+        grad_fn = jax.jit(
+            jax.grad(
+                lambda q_, k_, v_: jnp.sum(jnp.sin(ring(q_, k_, v_))),
+                argnums=(0, 1, 2),
+            )
+        )
+        with comm_audit() as prof:
+            grad_fn(q, k, v)
+        kv = b * (s // n) * h * d * F32
+        # forward ring: K, V, index; backward ring: K, V, their f32
+        # gradient accumulators, index — five rotating tensors
+        fwd_bytes = n * (2 * kv + 4)
+        bwd_bytes = n * (4 * kv + 4)
+        assert prof.ops("ppermute", "fsdp") == (3 + 5) * n
+        assert prof.payload_bytes("ppermute", "fsdp") == fwd_bytes + bwd_bytes
+        assert prof.wire_bytes("ppermute", "fsdp") == fwd_bytes + bwd_bytes
+
+    def test_ulysses_all_to_all_closed_form(self, mesh8):
+        from torchdistx_tpu.ops.attention import ulysses_attention
+
+        n = 8
+        b, s, h, d = 2, 64, 8, 16
+        q, k, v = self._qkv(b, s, h, d, seed=2)
+        fn = jax.jit(
+            shard_map(
+                lambda q_, k_, v_: ulysses_attention(
+                    q_, k_, v_, axis="fsdp", causal=True, use_flash=False
+                ),
+                mesh=mesh8,
+                in_specs=(P(None, "fsdp"),) * 3,
+                out_specs=P(None, "fsdp"),
+                check_vma=False,
+            )
+        )
+        with comm_audit() as prof:
+            fn(q, k, v)
+        # q/k/v reshard out, attention output reshards back: four
+        # all-to-alls of one per-device tensor each
+        t = b * (s // n) * h * d * F32
+        assert prof.ops("all_to_all", "fsdp") == 4
+        assert prof.payload_bytes("all_to_all", "fsdp") == 4 * t
+        # each device keeps its own slice: (n-1)/n of the payload on wire
+        assert prof.wire_bytes("all_to_all", "fsdp") == 4 * t * (n - 1) / n
+
+
 class TestShardingAudit:
     def test_flags_deliberate_replication(self, mesh8):
         big = jax.device_put(

@@ -1,0 +1,666 @@
+"""tdx-lint contract tests: per-rule fixtures, suppression semantics,
+the exact-findings baseline gate, and the CLI's exit-code / JSON-schema
+contracts.
+
+Fixture snippets are linted in-memory through ``lint_source`` (the test
+seam) — tests/ is deliberately outside the committed lint scope, so
+violation snippets here can never leak into the repo baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from torchdistx_tpu.analysis import (
+    LINT_SCHEMA,
+    RULE_CATALOG,
+    compare_to_baseline,
+    default_rules,
+    finding_key,
+    lint_source,
+    parse_suppressions,
+    run_lint,
+    validate_lint_report,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CLI = REPO_ROOT / "scripts" / "tdx_lint.py"
+BASELINE = REPO_ROOT / "expectations" / "static_analysis_baseline.json"
+
+
+def _lint(source: str, rel_path: str = "pkg/mod.py", shared=None):
+    """Lint a dedented snippet, returning (findings, used_suppressions)."""
+    return lint_source(
+        rel_path, textwrap.dedent(source), default_rules(), shared=shared
+    )
+
+
+def _rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# suppression comment parsing + TDX100
+
+
+class TestSuppressions:
+    def test_parse_extracts_rules_and_justification(self):
+        src = "x = 1  # tdx-lint: disable=TDX102,TDX103 -- seeded bench data\n"
+        (sup,) = parse_suppressions("a.py", src)
+        assert sup.rules == ("TDX102", "TDX103")
+        assert sup.justification == "seeded bench data"
+        assert sup.valid
+
+    def test_hash_inside_string_is_not_a_suppression(self):
+        src = 's = "# tdx-lint: disable=TDX102 -- not a comment"\n'
+        assert parse_suppressions("a.py", src) == []
+
+    def test_valid_suppression_drops_finding_and_is_reported(self):
+        findings, used = _lint(
+            """\
+            import jax
+            k = jax.random.PRNGKey(0)  # tdx-lint: disable=TDX102 -- test fixture key
+            """
+        )
+        assert findings == []
+        assert len(used) == 1 and used[0].rules == ("TDX102",)
+
+    def test_missing_justification_suppresses_nothing_and_adds_tdx100(self):
+        findings, used = _lint(
+            """\
+            import jax
+            k = jax.random.PRNGKey(0)  # tdx-lint: disable=TDX102
+            """
+        )
+        # the original finding survives AND the malformed comment is flagged
+        assert _rules_of(findings) == ["TDX100", "TDX102"]
+        assert used == []
+
+    def test_suppression_for_wrong_rule_does_not_cover(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            k = jax.random.PRNGKey(0)  # tdx-lint: disable=TDX104 -- wrong rule id
+            """
+        )
+        assert _rules_of(findings) == ["TDX102"]
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive/negative fixtures
+
+
+class TestTDX101DonatedJit:
+    def test_donated_jit_without_out_shardings_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            run = jax.jit(step, donate_argnums=(0, 1))
+            """
+        )
+        assert _rules_of(findings) == ["TDX101"]
+        assert findings[0].line == 2
+
+    def test_partial_jit_decorator_form_flagged(self):
+        findings, _ = _lint(
+            """\
+            import functools, jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run(carry):
+                return carry
+            """
+        )
+        assert _rules_of(findings) == ["TDX101"]
+
+    def test_donate_argnames_also_flagged(self):
+        findings, _ = _lint(
+            "import jax\nrun = jax.jit(step, donate_argnames=('params',))\n"
+        )
+        assert _rules_of(findings) == ["TDX101"]
+
+    def test_out_shardings_satisfies(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            run = jax.jit(step, donate_argnums=(0,), out_shardings=(sh, None))
+            """
+        )
+        assert findings == []
+
+    def test_kwargs_splat_satisfies(self):
+        findings, _ = _lint(
+            "import jax\nrun = jax.jit(step, donate_argnums=(0,), **extra)\n"
+        )
+        assert findings == []
+
+    def test_undonated_jit_is_fine(self):
+        findings, _ = _lint("import jax\nrun = jax.jit(step)\n")
+        assert findings == []
+
+
+class TestTDX102StatefulRng:
+    def test_raw_prngkey_flagged(self):
+        findings, _ = _lint("import jax\nk = jax.random.PRNGKey(42)\n")
+        assert _rules_of(findings) == ["TDX102"]
+        assert "counter" in findings[0].message
+
+    def test_np_global_generator_flagged(self):
+        findings, _ = _lint("import numpy as np\nx = np.random.randn(4)\n")
+        assert _rules_of(findings) == ["TDX102"]
+
+    def test_seeded_randomstate_is_fine(self):
+        findings, _ = _lint(
+            "import numpy as np\nrs = np.random.RandomState(0)\nx = rs.randn(4)\n"
+        )
+        assert findings == []
+
+    def test_default_rng_is_fine(self):
+        findings, _ = _lint(
+            "import numpy as np\nrng = np.random.default_rng(0)\n"
+        )
+        assert findings == []
+
+    def test_utils_rng_module_exempt(self):
+        findings, _ = _lint(
+            "import jax\nk = jax.random.PRNGKey(0)\n",
+            rel_path="torchdistx_tpu/utils/rng.py",
+        )
+        assert findings == []
+
+    def test_key_plumbing_is_fine(self):
+        findings, _ = _lint("import jax\na, b = jax.random.split(key)\n")
+        assert findings == []
+
+
+class TestTDX103RawCollective:
+    def test_raw_psum_flagged(self):
+        findings, _ = _lint(
+            """\
+            from jax import lax
+
+            def loss(x):
+                return lax.pmean(x, "dp")
+            """
+        )
+        assert _rules_of(findings) == ["TDX103"]
+        assert "obs/comm.py" in findings[0].message
+
+    def test_collectives_module_exempt(self):
+        findings, _ = _lint(
+            'from jax import lax\n\ndef all_mean(x, axis):\n    return lax.pmean(x, axis)\n',
+            rel_path="torchdistx_tpu/parallel/collectives.py",
+        )
+        assert findings == []
+
+    def test_enclosing_booking_call_exempts(self):
+        findings, _ = _lint(
+            """\
+            from jax import lax
+
+            def ring(x, axis, n):
+                record_collective("ppermute", axis, x, count=n)
+                return lax.ppermute(x, axis, perm)
+            """
+        )
+        assert findings == []
+
+    def test_record_helper_prefix_exempts(self):
+        findings, _ = _lint(
+            """\
+            from jax import lax
+
+            def step(x):
+                _record_ring_pass("sp", 8, (x,))
+                return lax.all_to_all(x, "sp", 0, 1)
+            """
+        )
+        assert findings == []
+
+    def test_booking_in_sibling_function_does_not_exempt(self):
+        findings, _ = _lint(
+            """\
+            from jax import lax
+
+            def book(x):
+                record_collective("psum", "dp", x)
+
+            def loss(x):
+                return lax.psum(x, "dp")
+            """
+        )
+        assert _rules_of(findings) == ["TDX103"]
+
+
+class TestTDX104HostSync:
+    def test_item_in_jitted_def_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+
+            @jax.jit
+            def step(c):
+                v = c.item()
+                return v
+            """
+        )
+        assert _rules_of(findings) == ["TDX104"]
+        assert findings[0].line == 5
+
+    def test_float_in_scan_body_by_name_flagged(self):
+        findings, _ = _lint(
+            """\
+            from jax import lax
+
+            def body(c, x):
+                v = float(c)
+                return c, v
+
+            out = lax.scan(body, c0, xs)
+            """
+        )
+        assert _rules_of(findings) == ["TDX104"]
+
+    def test_np_asarray_in_while_loop_lambda_flagged(self):
+        findings, _ = _lint(
+            """\
+            import numpy as np
+            from jax import lax
+
+            out = lax.while_loop(cond, lambda c: np.asarray(c), c0)
+            """
+        )
+        assert _rules_of(findings) == ["TDX104"]
+
+    def test_block_until_ready_in_jitted_def_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+
+            @jax.jit
+            def step(c):
+                return c.block_until_ready()
+            """
+        )
+        assert _rules_of(findings) == ["TDX104"]
+
+    def test_item_in_plain_function_is_fine(self):
+        findings, _ = _lint(
+            """\
+            def fetch(c):
+                return c.item()
+            """
+        )
+        assert findings == []
+
+    def test_float_of_constant_is_fine(self):
+        findings, _ = _lint(
+            """\
+            import jax
+
+            @jax.jit
+            def step(c):
+                return c * float(2)
+            """
+        )
+        assert findings == []
+
+
+class TestTDX105Metrics:
+    def test_counter_set_flagged(self):
+        findings, _ = _lint(
+            """\
+            c = registry.counter("tdx_serve_requests_total")
+            c.set(3)
+            """
+        )
+        assert _rules_of(findings) == ["TDX105"]
+        assert "monotone" in findings[0].message
+
+    def test_counter_negative_inc_flagged(self):
+        findings, _ = _lint(
+            """\
+            c = registry.counter("tdx_serve_requests_total")
+            c.inc(-1)
+            """
+        )
+        assert _rules_of(findings) == ["TDX105"]
+
+    def test_counter_positive_inc_fine(self):
+        findings, _ = _lint(
+            """\
+            c = registry.counter("tdx_serve_requests_total")
+            c.inc(2)
+            """
+        )
+        assert findings == []
+
+    def test_gauge_set_fine(self):
+        findings, _ = _lint(
+            """\
+            g = registry.gauge("tdx_serve_depth")
+            g.set(3)
+            """
+        )
+        assert findings == []
+
+    def test_unregistered_tdx_metric_family_flagged(self):
+        findings, _ = _lint(
+            'fam = MetricFamily("tdx_ghost_series_total", "doc")\n'
+        )
+        assert _rules_of(findings) == ["TDX105"]
+        assert "ghost" in findings[0].message
+
+    def test_registration_in_another_file_satisfies(self):
+        # cross-file: pass the shared scratchpad between two lint_source
+        # calls, the way run_lint's collect pass does for the whole scan set
+        shared = {}
+        _lint(
+            'reg.counter("tdx_ghost_series_total")\n',
+            rel_path="pkg/registry.py",
+            shared=shared,
+        )
+        findings, _ = _lint(
+            'fam = MetricFamily("tdx_ghost_series_total", "doc")\n',
+            rel_path="pkg/exporter.py",
+            shared=shared,
+        )
+        assert findings == []
+
+    def test_collector_prefix_root_satisfies(self):
+        shared = {}
+        _lint(
+            """\
+            def collect(prefix="tdx_fleet"):
+                pass
+            """,
+            rel_path="pkg/collector.py",
+            shared=shared,
+        )
+        findings, _ = _lint(
+            'fam = MetricFamily("tdx_fleet_route_depth", "doc")\n',
+            rel_path="pkg/exporter.py",
+            shared=shared,
+        )
+        assert findings == []
+
+    def test_non_tdx_family_ignored(self):
+        findings, _ = _lint(
+            'fam = MetricFamily("process_cpu_seconds_total", "doc")\n'
+        )
+        assert findings == []
+
+
+class TestTDX106CounterRowDeterminism:
+    def test_wall_clock_in_counter_row_function_flagged(self):
+        findings, _ = _lint(
+            """\
+            import time
+
+            def emit(ledger):
+                ledger.add(row(name="tdx_x_total", metric_class="counter"))
+                return time.time()
+            """
+        )
+        assert _rules_of(findings) == ["TDX106"]
+        assert "EXACTLY" in findings[0].message
+
+    def test_set_iteration_in_counter_row_function_flagged(self):
+        findings, _ = _lint(
+            """\
+            def emit(ledger, names):
+                for n in set(names):
+                    ledger.add(row(name=n, metric_class="counter"))
+            """
+        )
+        assert _rules_of(findings) == ["TDX106"]
+        assert "sort" in findings[0].message
+
+    def test_wall_clock_outside_counter_rows_fine(self):
+        findings, _ = _lint(
+            """\
+            import time
+
+            def emit(ledger):
+                ledger.add(row(name="tdx_x_ms", metric_class="timing"))
+                return time.time()
+            """
+        )
+        assert findings == []
+
+    def test_sorted_iteration_fine(self):
+        findings, _ = _lint(
+            """\
+            def emit(ledger, names):
+                for n in sorted(set(names)):
+                    ledger.add(row(name=n, metric_class="counter"))
+            """
+        )
+        # sorted(set(...)) iterates the sorted list, not the set
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: an injected violation of EACH rule is caught with rule id
+# and file:line
+
+
+_VIOLATIONS = {
+    # rule id -> (snippet, expected line of the finding)
+    "TDX100": ("import jax\nk = jax.random.PRNGKey(0)  # tdx-lint: disable=TDX102\n", 2),
+    "TDX101": ("import jax\nrun = jax.jit(f, donate_argnums=(0,))\n", 2),
+    "TDX102": ("import jax\nk = jax.random.PRNGKey(0)\n", 2),
+    "TDX103": ("from jax import lax\ny = lax.psum(x, 'dp')\n", 2),
+    "TDX104": (
+        "import jax\n\n@jax.jit\ndef step(c):\n    return c.item()\n",
+        5,
+    ),
+    "TDX105": ("c = reg.counter('tdx_q_total')\nc.dec()\n", 2),
+    "TDX106": (
+        "import time\n\ndef emit(led):\n"
+        "    led.add(row(metric_class='counter'))\n"
+        "    return time.perf_counter()\n",
+        5,
+    ),
+}
+
+
+class TestEveryRuleCatchesInjectedViolation:
+    @pytest.mark.parametrize("rule_id", sorted(_VIOLATIONS))
+    def test_injected_violation_caught_with_location(self, rule_id):
+        snippet, line = _VIOLATIONS[rule_id]
+        findings, _ = lint_source("inject/%s.py" % rule_id, snippet, default_rules())
+        hits = [f for f in findings if f.rule == rule_id]
+        assert hits, "rule %s missed its injected violation" % rule_id
+        assert hits[0].path == "inject/%s.py" % rule_id
+        assert hits[0].line == line
+        assert hits[0].severity == RULE_CATALOG[rule_id][0]
+
+    def test_catalog_covers_all_default_rules(self):
+        ids = {r.rule_id for r in default_rules()} | {"TDX100"}
+        assert ids == set(RULE_CATALOG) == set(_VIOLATIONS)
+
+
+# ---------------------------------------------------------------------------
+# baseline gate semantics + report schema
+
+
+def _mkfinding(rule="TDX102", path="a.py", line=1):
+    return {
+        "rule": rule,
+        "severity": "error",
+        "path": path,
+        "line": line,
+        "col": 0,
+        "message": "m",
+    }
+
+
+class TestBaselineCompare:
+    def test_exact_compare_reports_new_and_fixed(self):
+        report = {"findings": [_mkfinding(line=1), _mkfinding(line=2)]}
+        baseline = {"findings": [_mkfinding(line=2), _mkfinding(line=3)]}
+        diff = compare_to_baseline(report, baseline)
+        assert [f["line"] for f in diff["new"]] == [1]
+        assert [f["line"] for f in diff["fixed"]] == [3]
+
+    def test_identity_is_rule_path_line_not_message(self):
+        a = _mkfinding()
+        b = dict(_mkfinding(), message="different wording", col=7)
+        diff = compare_to_baseline({"findings": [a]}, {"findings": [b]})
+        assert diff == {"new": [], "fixed": []}
+        assert finding_key(a) == finding_key(b)
+
+
+class TestReportSchema:
+    def test_run_lint_report_validates(self, tmp_path):
+        f = tmp_path / "clean.py"
+        f.write_text("x = 1  # tdx-lint: disable=TDX102 -- exercised suppression\n")
+        # note: a suppression with no matching finding is unused, so it is
+        # NOT reported; add a real finding + suppression pair instead
+        f.write_text(
+            "import jax\n"
+            "k = jax.random.PRNGKey(0)  # tdx-lint: disable=TDX102 -- fixture\n"
+        )
+        report = run_lint([str(f)], default_rules())
+        assert report["schema"] == LINT_SCHEMA
+        assert report["files_scanned"] == 1
+        assert report["findings"] == []
+        assert len(report["suppressions"]) == 1
+        assert validate_lint_report(report) == []
+
+    def test_unparseable_file_becomes_tdx000(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def oops(:\n")
+        report = run_lint([str(f)], default_rules())
+        assert [x["rule"] for x in report["findings"]] == ["TDX000"]
+        assert validate_lint_report(report) == []
+
+    def test_validator_catches_bad_docs(self):
+        assert validate_lint_report([]) == ["report is not a JSON object"]
+        errs = validate_lint_report({"schema": "nope"})
+        assert any(e.startswith("schema:") for e in errs)
+        doc = {
+            "schema": LINT_SCHEMA,
+            "files_scanned": 1,
+            "rules": ["TDX101"],
+            "findings": [dict(_mkfinding(), severity="fatal", col="0")],
+            "suppressions": [
+                {"path": "a.py", "line": 1, "rules": ["TDX102"], "justification": " "}
+            ],
+        }
+        errs = validate_lint_report(doc)
+        assert any("severity" in e for e in errs)
+        assert any(".col" in e for e in errs)
+        assert any("justification" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts (exit codes, last-stdout-line JSON verdict)
+
+
+def _cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, str(CLI), *map(str, args)],
+        capture_output=True,
+        text=True,
+        cwd=cwd or REPO_ROOT,
+        timeout=120,
+    )
+
+
+def _last_json(proc):
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class TestCLI:
+    def test_violation_fails_strict_naming_rule_and_location(self, tmp_path):
+        f = tmp_path / "bad.py"
+        f.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        proc = _cli(f, "--no-baseline", "--strict")
+        assert proc.returncode == 1
+        assert "TDX102" in proc.stdout
+        assert "%s:2" % f in proc.stdout  # per-finding line has file:line
+        verdict = _last_json(proc)
+        assert verdict["schema"] == "tdx-lint-verdict-v1"
+        assert verdict["ok"] is False
+        assert verdict["new"][0]["rule"] == "TDX102"
+
+    def test_clean_scan_exits_zero_with_ok_verdict(self, tmp_path):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        proc = _cli(f, "--no-baseline", "--strict")
+        assert proc.returncode == 0
+        assert _last_json(proc)["ok"] is True
+
+    def test_missing_baseline_exits_two(self, tmp_path):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        proc = _cli(f, "--baseline", tmp_path / "absent.json", "--strict")
+        assert proc.returncode == 2
+        assert "baseline" in proc.stderr
+
+    def test_baseline_roundtrip_then_new_and_fixed_both_fail(self, tmp_path):
+        f = tmp_path / "mod.py"
+        base = tmp_path / "baseline.json"
+        f.write_text("x = 1\n")
+
+        # pin, then strict-pass against the pin
+        assert _cli(f, "--baseline", base, "--update-baseline").returncode == 0
+        doc = json.loads(base.read_text())
+        assert validate_lint_report(doc) == []
+        assert _cli(f, "--baseline", base, "--strict").returncode == 0
+
+        # inject a violation -> NEW finding fails, named with rule+file:line
+        f.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        proc = _cli(f, "--baseline", base, "--strict")
+        assert proc.returncode == 1
+        assert "FAIL: new finding TDX102" in proc.stderr
+        assert ":2" in proc.stderr
+
+        # accept it into the baseline, then fix it -> FIXED also fails,
+        # pointing at the --update-baseline refresh workflow
+        assert _cli(f, "--baseline", base, "--update-baseline").returncode == 0
+        f.write_text("x = 1\n")
+        proc = _cli(f, "--baseline", base, "--strict")
+        assert proc.returncode == 1
+        assert "no longer present" in proc.stderr
+        assert "--update-baseline" in proc.stderr
+
+    def test_list_rules_prints_catalog(self):
+        proc = _cli("--list-rules")
+        assert proc.returncode == 0
+        for rid in RULE_CATALOG:
+            assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the committed repo gate
+
+
+class TestCommittedBaseline:
+    def test_committed_baseline_validates_and_has_no_donation_or_comm_debt(self):
+        doc = json.loads(BASELINE.read_text())
+        assert validate_lint_report(doc) == []
+        rules = [f["rule"] for f in doc["findings"]]
+        assert "TDX101" not in rules, "donated-jit debt must be fixed, not pinned"
+        assert "TDX103" not in rules, "unbooked-collective debt must be fixed, not pinned"
+
+    def test_repo_scan_matches_committed_baseline_exactly(self):
+        report = run_lint(
+            ["torchdistx_tpu", "scripts", "__graft_entry__.py", "examples", "bench.py"],
+            default_rules(),
+            root=str(REPO_ROOT),
+        )
+        baseline = json.loads(BASELINE.read_text())
+        diff = compare_to_baseline(report, baseline)
+        assert diff == {"new": [], "fixed": []}, (
+            "repo drifted from expectations/static_analysis_baseline.json — "
+            "fix the finding or refresh with scripts/tdx_lint.py --update-baseline"
+        )

@@ -45,6 +45,8 @@ reduce_scatter     input bytes S          (n-1)/n * S
 all_gather         gathered bytes S       (n-1)/n * S
 broadcast          operand bytes S        (n-1)/n * S  (pipelined 1-to-all)
 exchange/shift     operand bytes S        S * len(perm)/n  (senders only)
+ppermute           operand bytes S        S  (full-rotation ring hop)
+all_to_all         operand bytes S        (n-1)/n * S  (keeps own slice)
 =================  =====================  ==========================
 
 ``broadcast`` is lowered here as mask+psum (collectives.broadcast); the
@@ -83,6 +85,8 @@ _KINDS = (
     "allreduce_linear_bwd",
     "copy_psum_grad_bwd",
     "pmean",
+    "ppermute",
+    "all_to_all",
 )
 
 
@@ -250,6 +254,8 @@ _WIRE = {
     "reduce_scatter": lambda n, s: (n - 1) / n,
     "exchange": lambda n, s: (s if s is not None else n) / n,
     "shift": lambda n, s: 1.0,  # every device sends in a ring shift
+    "ppermute": lambda n, s: 1.0,  # full rotation: every device sends
+    "all_to_all": lambda n, s: (n - 1) / n,  # own slice stays local
 }
 
 

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..obs.comm import record_collective as _record_comm
 from ..utils.compat import axis_size
 
 __all__ = [
@@ -33,6 +34,22 @@ __all__ = [
     "cached_attention",
     "slot_cached_attention",
 ]
+
+
+def _record_ring_pass(axis: str, n: int, blocks: tuple) -> None:
+    """Book one ring pass's ``lax.ppermute`` traffic into the comm audit.
+
+    The ``lax.scan`` body traces ONCE but executes ``n`` times (length=n,
+    including the final home-coming hop that returns each block to its
+    owner), so each rotating tensor contributes ``n`` ppermute ops of its
+    per-device block bytes — the explicit static-trip-count accounting the
+    ``obs.comm`` module docstring requires of loop-executed collectives.
+    The textbook ring needs only ``n-1`` hops; this implementation pays
+    the extra home-coming rotation to keep the carry structure static,
+    and the audit books what actually executes.
+    """
+    for blk in blocks:
+        _record_comm("ppermute", axis, blk, count=n, axis_size=n)
 
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -524,6 +541,7 @@ def ring_attention(
     acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
     max0 = jnp.full((b, hq, sq), neg_inf)
     sum0 = jnp.zeros((b, hq, sq), jnp.float32)
+    _record_ring_pass(axis, n, (k, v, idx))
     (acc, row_max, row_sum, _, _, _), _ = lax.scan(
         block, (acc0, max0, sum0, k, v, idx), None, length=n
     )
@@ -680,6 +698,7 @@ def _ring_flash_fwd(
     acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
     m0 = jnp.full((b, hq, sq), jnp.float32(-1e30))
     l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    _record_ring_pass(axis, n, (k, v, idx))
     (acc, m, l, _, _, _), _ = lax.scan(
         step, (acc0, m0, l0, k, v, idx), None, length=n
     )
@@ -774,6 +793,9 @@ def _ring_flash_bwd_rule(
     )
     dk0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
     dv0 = jnp.zeros((b * hkv, skv, d), jnp.float32)
+    # five tensors rotate in the backward ring: the K/V blocks AND their
+    # f32 gradient accumulators, plus the block index
+    _record_ring_pass(axis, n, (kh, vh, dk0, dv0, idx))
     (dqh, dbh, _, _, dkh, dvh, _), _ = lax.scan(
         step, (dq0, db0, kh, vh, dk0, dv0, idx), None, length=n
     )
@@ -881,6 +903,8 @@ def ulysses_attention(
             "for non-dividing head counts"
         )
     # (b, s/n, h, d) -> (b, s, h/n, d): split heads, concat sequence
+    for t in (q, k, v):
+        _record_comm("all_to_all", axis, t, axis_size=n)
     qg = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
@@ -893,6 +917,7 @@ def ulysses_attention(
     else:
         out = multihead_attention(qg, kg, vg, causal=causal, scale=scale)
     # inverse reshard: (b, s, h/n, d) -> (b, s/n, h, d)
+    _record_comm("all_to_all", axis, out, axis_size=n)
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 
 

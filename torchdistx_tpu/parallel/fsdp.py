@@ -43,9 +43,31 @@ from .comm_hooks import DefaultState, Hook, HookContext, allreduce_hook
 __all__ = [
     "fsdp_partition_spec",
     "fsdp_shard_rule",
+    "donated_carry_shardings",
     "optimizer_state_shardings",
     "ShardedTrainStep",
 ]
+
+
+def donated_carry_shardings(*trees: Any) -> tuple:
+    """Per-tree ``out_shardings`` mirroring each input's ACTUAL placement.
+
+    The companion of :func:`optimizer_state_shardings` for donated-carry
+    steps (TDX101): jit does not propagate input shardings into outputs,
+    so a ``donate_argnums`` carry must pin its outputs to the layouts the
+    inputs arrived with, or the carry silently decays to jit-chosen
+    (usually replicated) placements on the first step.  Leaves without a
+    concrete sharding (numpy inputs, abstract values) map to ``None`` —
+    jit's free choice, exactly the prior behavior for them.
+    """
+
+    def leaf_sharding(x: Any):
+        sh = getattr(x, "sharding", None)
+        return sh if isinstance(sh, jax.sharding.Sharding) else None
+
+    return tuple(
+        jax.tree_util.tree_map(leaf_sharding, t) for t in trees
+    )
 
 
 def accumulate_grads(
@@ -330,7 +352,7 @@ class ShardedTrainStep:
 
     # -- the step ----------------------------------------------------------
 
-    def _build(self, params: Any) -> None:
+    def _build(self, params: Any, opt_state: Any) -> None:
         mesh = self.mesh
         shard_axis = self.shard_axis
         all_axes = tuple(mesh.axis_names)
@@ -463,7 +485,14 @@ class ShardedTrainStep:
             )
             return params, opt_state, loss
 
-        self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        # donated carries keep the layouts they arrived with — without
+        # this the params/opt_state outputs decay to jit-chosen
+        # placements (TDX101; the optimizer-state lesson applied to the
+        # step itself)
+        p_sh, o_sh = donated_carry_shardings(params, opt_state)
+        self._jitted = jax.jit(
+            step, donate_argnums=(0, 1), out_shardings=(p_sh, o_sh, None)
+        )
         from ..obs.recompile import track_jit_cache
 
         track_jit_cache("sharded_train_step", self._jitted)
@@ -472,7 +501,7 @@ class ShardedTrainStep:
     def __call__(self, params: Any, opt_state: Any, batch: Any):
         """Run one step.  Returns (params, opt_state, loss)."""
         if self._jitted is None:
-            self._build(params)
+            self._build(params, opt_state)
         hook_step = self.hook_state.step_args()
         if hook_step is None:
             hook_step = jnp.int32(0)

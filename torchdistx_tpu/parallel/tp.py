@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .fsdp import (
     accumulate_grads,
+    donated_carry_shardings,
     fsdp_partition_spec,
     optimizer_state_shardings,
     strided_split,
@@ -150,11 +151,27 @@ class GSPMDTrainStep:
             )
             return params, opt_state, loss
 
-        self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        self._step = step
+        # built lazily at the first __call__, where the actual carry
+        # placements are known (and rebuildable: elastic reshard resets
+        # _jitted to None when the mesh changes under the step)
+        self._jitted = None
+        self._warned_shardings: set = set()
+
+    def _build(self, params: Any, opt_state: Any) -> None:
+        # donated carries keep their arrival layouts (TDX101): GSPMD
+        # propagation covers values the outputs READ, but pinning
+        # out_shardings keeps fresh outputs (optimizer zeros, dtype
+        # casts) from decaying to jit-chosen placements
+        p_sh, o_sh = donated_carry_shardings(params, opt_state)
+        self._jitted = jax.jit(
+            self._step,
+            donate_argnums=(0, 1),
+            out_shardings=(p_sh, o_sh, None),
+        )
         from ..obs.recompile import track_jit_cache
 
         track_jit_cache("gspmd_train_step", self._jitted)
-        self._warned_shardings: set = set()
 
     def init_optimizer(self, params: Any) -> Any:
         state_shape = jax.eval_shape(self.optimizer.init, params)
@@ -200,4 +217,6 @@ class GSPMDTrainStep:
             return jax.device_put(x, target)
 
         batch = jax.tree_util.tree_map(place, batch)
+        if self._jitted is None:
+            self._build(params, opt_state)
         return self._jitted(params, opt_state, batch)

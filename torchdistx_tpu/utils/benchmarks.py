@@ -189,8 +189,17 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
 
     # N steps in ONE jitted lax.scan: per-call dispatch through the axon
     # relay would swamp the measurement; donation reuses the params/
-    # optimizer buffers (the chip is nearly full)
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    # optimizer buffers (the chip is nearly full).  The donated carry
+    # keeps its arrival placements via out_shardings (TDX101) — layout
+    # (tiling) choices remain jit's, so warm_to_steady_state is still
+    # required before timing.
+    from ..parallel.fsdp import donated_carry_shardings
+
+    (carry_sh,) = donated_carry_shardings((params, opt_state))
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0,), out_shardings=(carry_sh, None)
+    )
     def run(carry):
         return lax.scan(step, carry, None, length=n_steps)
 
