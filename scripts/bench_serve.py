@@ -189,6 +189,29 @@ def _parse_args():
         "redistribution pinned closed-form against the comm audit",
     )
     ap.add_argument(
+        "--scenario",
+        default=None,
+        metavar="NAMES",
+        help="comma-separated open-loop traffic scenarios from the "
+        "serve/workload.py catalog (poisson, diurnal, bursty, "
+        "flash_crowd): each appends an autoscale A/B phase replaying "
+        "the scenario's deterministic tick-stamped arrival stream "
+        "through every static fleet size the policy allows AND a "
+        "closed-loop AutoscaleController fleet — the STRICT verdict is "
+        "that autoscaling beats every static of equal-or-lower "
+        "replica-tick cost on deadline attainment, is Pareto-undominated, "
+        "executes a full scale-up + scale-down cycle, and keeps every "
+        "stream bit-identical to the single-engine oracle",
+    )
+    ap.add_argument(
+        "--autoscale",
+        default=None,
+        metavar="POLICY",
+        help="ScalingPolicy for the --scenario phases: 'default', an "
+        "inline JSON object, or a path to one (serve/autoscale.py "
+        "schema); defaults to 'default' when --scenario is given",
+    )
+    ap.add_argument(
         "--slo",
         default=None,
         metavar="SPEC",
@@ -236,6 +259,20 @@ def _spec_values(args) -> list:
     if any(k < 0 for k in ks):
         raise SystemExit(f"--speculate values must be >= 0, got {ks}")
     return [0] + [k for k in dict.fromkeys(ks) if k != 0]
+
+
+def _scenario_values(args) -> list:
+    """The ``--scenario`` sweep, deduped in request order.  Validated
+    against a literal copy of the serve/workload.py catalog names — the
+    parent must stay import-free (a parent touching jax alongside a TPU
+    child is the two-process relay wedge), so it cannot ask the module."""
+    names = [
+        s.strip() for s in str(args.scenario or "").split(",") if s.strip()
+    ]
+    unknown = set(names) - {"poisson", "diurnal", "bursty", "flash_crowd"}
+    if unknown:
+        raise SystemExit(f"unknown --scenario names: {sorted(unknown)}")
+    return list(dict.fromkeys(names))
 
 
 def _phase_summary(rec: dict) -> dict:
@@ -318,6 +355,20 @@ def _phase_summary(rec: dict) -> dict:
             migrated_queued=(rec.get("remove_summary") or {}).get(
                 "migrated_queued"
             ),
+        )
+    if "autoscale_verdict" in rec:  # the closed-loop autoscale A/B
+        v = rec.get("autoscale_verdict") or {}
+        out.update(
+            scenario=rec.get("scenario"),
+            autoscale_ok=v.get("ok"),
+            requests=v.get("requests"),
+            attained_autoscale=v.get("attained_autoscale"),
+            replica_ticks_autoscale=v.get("replica_ticks_autoscale"),
+            attained_static=v.get("attained_static"),
+            replica_ticks_static=v.get("replica_ticks_static"),
+            scale_ups=v.get("scale_ups"),
+            scale_downs=v.get("scale_downs"),
+            streams_identical=v.get("streams_identical"),
         )
     if "handoff_wire_bytes_expected" in rec:  # the disaggregated leg
         out.update(
@@ -439,6 +490,19 @@ def _supervise(args) -> None:
                     },
                 )
             )
+    for sc in _scenario_values(args):
+        # one A/B phase per traffic scenario; the child pins its own
+        # engine geometry to the scenario's token envelope, so no
+        # TDX_SERVE_CHUNK override here
+        plan.append(
+            (
+                f"autoscale_{sc}",
+                {
+                    "TDX_SERVE_PHASE": "autoscale",
+                    "TDX_SERVE_SCENARIO": sc,
+                },
+            )
+        )
 
     def emit():
         # the speculation A/B verdict, before the summary snapshots it:
@@ -1516,7 +1580,9 @@ def _maybe_slo_error(args, record: dict) -> None:
         record["error"] = f"SLO breached under --slo-strict: {detail}"
 
 
-def _dump_obs_fleet(record: dict, fleet, tag: str, slo_spec=None) -> None:
+def _dump_obs_fleet(
+    record: dict, fleet, tag: str, slo_spec=None, collectors=()
+) -> None:
     """``_dump_obs`` for a whole fleet: ONE scrape surface — the
     exposition renders the fleet collector (replica-summed
     ``tdx_serve_*_total`` counters, so ``check_obs_artifacts`` validates
@@ -1548,6 +1614,10 @@ def _dump_obs_fleet(record: dict, fleet, tag: str, slo_spec=None) -> None:
     registry = obs.MetricsRegistry()
     registry.register_collector(fleet.collector())
     registry.register_collector(rep.engine.cost_book.collector())
+    for extra in collectors:
+        # e.g. the AutoscaleController's tdx_autoscale_* family — the
+        # scale loop scrapes from the SAME surface as the fleet
+        registry.register_collector(extra)
     if slo_spec is not None:
         registry.register_collector(
             obs.slo_collector(slo_spec, fleet), obj=fleet
@@ -1950,6 +2020,257 @@ def _child_fleet_disagg(args) -> None:
     print(json.dumps(record))
 
 
+def _child_autoscale(args) -> None:
+    """The closed-loop autoscale A/B (ISSUE 16 tentpole): one
+    deterministic open-loop scenario (serve/workload.py — every sample
+    from the utils/rng.py counter stream, so same seed => bit-identical
+    arrival stream) replayed tick-for-tick through every STATIC fleet
+    size the policy allows and through a fleet driven by an
+    ``AutoscaleController``.  Attainment and cost are measured in fleet
+    TICKS (finish_tick - arrival_tick <= deadline_ticks; cost =
+    replica-ticks), so the verdict is wall-clock-free and the counter
+    rows pin exactly.  STRICT errors unless autoscaling strictly beats
+    every static of equal-or-lower cost on attainment, no static
+    dominates it, at least one scale-up AND one scale-down executed,
+    and every stream (static and autoscaled) is bit-identical to the
+    single-engine oracle — scaling decides capacity, never tokens."""
+    sc_name = os.environ["TDX_SERVE_SCENARIO"]
+    policy_arg = args.autoscale or "default"
+    record, name, _k, plat = _phase_setup(
+        args, phase=f"autoscale_{sc_name}", scenario=sc_name,
+        autoscale=policy_arg,
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu import obs
+    from torchdistx_tpu.serve import (
+        AutoscaleController,
+        ScalingPolicy,
+        ServeEngine,
+        ServeFleet,
+        generate,
+        scenario,
+        workload_counters,
+    )
+
+    try:
+        policy = ScalingPolicy.from_json(policy_arg)
+        spec = scenario(sc_name)
+        work = generate(spec)
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        # geometry pinned to the scenario's token envelope (NOT the
+        # sweep's --decode-chunk/--slots): the catalog's arrival rates
+        # are calibrated against this capacity, so the A/B's pressure
+        # dynamics must not drift with unrelated CLI knobs
+        bucket = -(-spec.max_prompt_len // 8) * 8
+        max_len = bucket + spec.max_output_len
+        if max_len > limit:
+            raise RuntimeError(
+                f"scenario {sc_name} needs max_len {max_len} > model "
+                f"limit {limit}"
+            )
+        slots, k_chunk = 2, 4
+        record.update(
+            decode_chunk=k_chunk,
+            num_slots=slots,
+            requests=len(work),
+            max_len=max_len,
+            scenario_spec=spec.to_json(),
+            policy=policy.to_json(),
+        )
+
+        def build(role="serve"):
+            return ServeEngine(
+                model,
+                num_slots=slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                prefill_buckets=(bucket,),
+                **_mesh_kwargs(args),
+            )
+
+        watcher = obs.RecompileWatcher()
+        # the bit-identity oracle compiles every program the replays can
+        # reach (both donated-carry call signatures included): engines
+        # share the model-level jit store, so the A/B fleets below —
+        # and the controller's warmed mid-replay adds — dispatch
+        # compile-free
+        ref_tokens = [
+            r.tokens for r in build().run([w.submit_kwargs() for w in work])
+        ]
+        record["recompile_warmup"] = watcher.snapshot()
+        watcher.reset()  # the measured replays must compile NOTHING
+
+        def replay(fleet, ctrl=None):
+            """Open-loop tick replay: submissions between step N and
+            N+1 carry arrival tick N (the fleet.tick contract), one
+            controller evaluation per fleet tick."""
+            handles, finish_tick, i, tick = {}, {}, 0, 0
+            while i < len(work) or any(
+                not h.done() for h in handles.values()
+            ):
+                while i < len(work) and work[i].arrival_tick <= tick:
+                    handles[i] = fleet.submit(**work[i].submit_kwargs())
+                    i += 1
+                fleet.step()
+                tick = fleet.tick
+                if ctrl is not None:
+                    ctrl.tick()
+                for k, h in handles.items():
+                    if k not in finish_tick and h.done():
+                        finish_tick[k] = tick
+            streams_ok = len(handles) == len(work) and all(
+                np.array_equal(handles[k].result().tokens, ref_tokens[k])
+                for k in range(len(work))
+            )
+            attained = sum(
+                1
+                for k, ft in finish_tick.items()
+                if ft - work[k].arrival_tick <= work[k].deadline_ticks
+            )
+            return attained, tick, streams_ok
+
+        statics = {}
+        for n in range(policy.min_replicas, policy.max_replicas + 1):
+            att, ticks, s_ok = replay(
+                ServeFleet([build() for _ in range(n)])
+            )
+            statics[n] = {
+                "attained": att,
+                "replica_ticks": n * ticks,
+                "ticks": ticks,
+                "streams_identical": s_ok,
+            }
+
+        fleet_auto = ServeFleet(
+            [build() for _ in range(policy.min_replicas)]
+        )
+        ctrl = AutoscaleController(
+            fleet_auto, policy, engine_factory=build
+        )
+        att_auto, ticks_auto, auto_ok = replay(fleet_auto, ctrl)
+        record["recompile_measure"] = watcher.snapshot()
+
+        auto_cost = ctrl.counters["autoscale_replica_ticks"]
+        ups = ctrl.counters["autoscale_scale_ups"]
+        downs = ctrl.counters["autoscale_scale_downs"]
+        streams_equal = auto_ok and all(
+            s["streams_identical"] for s in statics.values()
+        )
+        comparable = {
+            n: s
+            for n, s in statics.items()
+            if s["replica_ticks"] <= auto_cost
+        }
+        dominated = any(
+            s["attained"] >= att_auto and s["replica_ticks"] <= auto_cost
+            for s in statics.values()
+        )
+        verdict_ok = (
+            streams_equal
+            and bool(comparable)
+            and all(
+                att_auto > s["attained"] for s in comparable.values()
+            )
+            and not dominated
+            and ups >= 1
+            and downs >= 1
+        )
+        record["autoscale_verdict"] = {
+            "ok": verdict_ok,
+            "requests": len(work),
+            "attained_autoscale": att_auto,
+            "replica_ticks_autoscale": auto_cost,
+            "ticks_autoscale": ticks_auto,
+            "attained_static": {
+                str(n): s["attained"] for n, s in statics.items()
+            },
+            "replica_ticks_static": {
+                str(n): s["replica_ticks"] for n, s in statics.items()
+            },
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "reroles": ctrl.counters["autoscale_reroles"],
+            "streams_identical": streams_equal,
+        }
+        # every scale decision with its FULL signal vector — the
+        # flight recorder and check_obs_artifacts --autoscale read the
+        # same stream from the record
+        record["scale_events"] = [
+            data for ev, _ts, data in fleet_auto.events if ev == "scale"
+        ]
+        # the pinned counter rows: the autoscaled fleet's aggregate
+        # stays pure in ``metrics`` (its exposition projection is
+        # exact-gated), while the controller's decision counters, the
+        # workload's exact shape, and both sides' tick-space A/B axes
+        # ride in ``autoscale_metrics`` (ints only — the ledger ingests
+        # both blocks and perf_gate --strict holds every row exactly)
+        record["metrics"] = fleet_auto.metrics_json()
+        ab = dict(workload_counters(work))
+        ab.update(ctrl.counters)
+        # NOT autoscale_-prefixed: that namespace is reserved for the
+        # controller counters the tdx_autoscale_* exposition projects
+        ab["attained_requests_auto"] = att_auto
+        ab["total_ticks_auto"] = ticks_auto
+        for n, s in statics.items():
+            ab[f"static{n}_attained_requests"] = s["attained"]
+            ab[f"static{n}_replica_ticks"] = s["replica_ticks"]
+        record["autoscale_metrics"] = {
+            "counters": ab,
+            "gauges": ctrl.metrics_json()["gauges"],
+        }
+        busiest = max(
+            fleet_auto.replicas,
+            key=lambda r: len(r.engine.finished_requests()),
+        )
+        _embed_cost(record, busiest.engine)
+        slo = _eval_slo(args, fleet_auto.finished_requests())
+        if slo is not None:
+            record["slo"] = slo
+        if not streams_equal:
+            record["error"] = (
+                "a replayed stream diverged from the single-engine "
+                "oracle — scaling must decide capacity, never tokens"
+            )
+        elif not verdict_ok:
+            record["error"] = (
+                f"autoscale A/B verdict failed on {sc_name}: "
+                f"auto {att_auto}/{len(work)} @ {auto_cost} "
+                "replica-ticks vs static "
+                + ", ".join(
+                    f"n={n}: {s['attained']}/{len(work)} @ "
+                    f"{s['replica_ticks']}"
+                    for n, s in statics.items()
+                )
+                + f" (scale_ups={ups}, scale_downs={downs})"
+            )
+        _maybe_slo_error(args, record)
+        _dump_obs_fleet(
+            record,
+            fleet_auto,
+            f"autoscale_{sc_name}",
+            slo_spec=_slo_spec(args),
+            collectors=[ctrl.collector()],
+        )
+        out_dir = os.environ.get("TDX_SERVE_TRACE_DIR")
+        if out_dir:
+            # the flight dump carries every scale decision (controller
+            # records them as kind="scale") for postmortem replay
+            from torchdistx_tpu.obs.flight import get_flight_recorder
+
+            record["flight_path"] = get_flight_recorder().dump(
+                os.path.join(
+                    out_dir, f"autoscale_{sc_name}_flight.jsonl"
+                ),
+                reason=f"bench_serve autoscale_{sc_name}",
+            )
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def main() -> None:
     args = _parse_args()
     if os.environ.get("TDX_SERVE_CHILD") == "1":
@@ -1968,6 +2289,8 @@ def main() -> None:
             _child_fleet_drain(args)
         elif phase == "fleet_disagg":
             _child_fleet_disagg(args)
+        elif phase == "autoscale":
+            _child_autoscale(args)
         else:
             _child(args)
     else:

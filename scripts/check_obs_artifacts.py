@@ -45,6 +45,16 @@ Usage:
     (one ``ph:"s"`` open and one ``ph:"f"`` close, opened before
     closed), so every cross-replica request chain is stitched, never
     dangling)
+  python scripts/check_obs_artifacts.py --autoscale BENCH_SERVE_CPU_AUTOSCALE.json
+    (autoscale-observatory validation: every non-error autoscale phase
+    must embed its FULL decision stream — one ``scale_events`` entry
+    per controller tick with strictly increasing fleet ticks, a legal
+    action, and the complete signal vector (burn state, windows,
+    per-replica load) — with stream-derived decision/scale-up/down
+    counts EQUAL to the phase's ``autoscale_metrics`` counters and to
+    the ``tdx_autoscale_*_total`` exposition samples, a passing
+    ``autoscale_verdict``, and (when dumped) a schema-valid flight
+    record carrying the same ``scale`` entries)
   python scripts/check_obs_artifacts.py --lint LINT_REPORT.json
     (tdx-lint-v1 schema validation for a ``scripts/tdx_lint.py
     --json-out``/``--update-baseline`` artifact — including the
@@ -366,6 +376,154 @@ def _check_slo_main(paths: list) -> None:
     print(f"slo artifacts OK ({n_reports} report(s), {n_flows} flow(s))")
 
 
+def _check_autoscale_main(paths: list) -> None:
+    """``--autoscale``: the scale-decision stream is the subsystem's
+    black box — every decision must be present, schema-complete, and
+    agree with the counters and the scrape surface, or a silent scaling
+    bug could hide behind a green verdict."""
+    if not paths:
+        raise SystemExit(__doc__)
+    actions = {"hold", "scale_up", "scale_down"}
+    states = {"ok", "warn", "page"}
+    required = (
+        "tick",
+        "action",
+        "reason",
+        "replicas_before",
+        "replicas_after",
+        "sustain",
+        "cooldown_remaining",
+        "policy",
+        "signal",
+    )
+    errors: list = []
+    n_phases = n_events = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable record: {e}")
+            continue
+        for name, phase in (record.get("phases") or {}).items():
+            if not isinstance(phase, dict) or "error" in phase:
+                continue
+            if "autoscale_verdict" not in phase:
+                continue
+            n_phases += 1
+            tag = f"{path}: phase {name}"
+            events = phase.get("scale_events")
+            if not isinstance(events, list) or not events:
+                errors.append(f"{tag}: no scale_events stream")
+                continue
+            n_events += len(events)
+            last_tick, ups, downs = -1, 0, 0
+            for i, ev in enumerate(events):
+                where = f"{tag} scale_events[{i}]"
+                if not isinstance(ev, dict):
+                    errors.append(f"{where}: not an object")
+                    continue
+                missing = [k for k in required if k not in ev]
+                if missing:
+                    errors.append(f"{where}: missing {missing}")
+                    continue
+                if not isinstance(ev["tick"], int) or ev["tick"] <= last_tick:
+                    errors.append(
+                        f"{where}: fleet ticks must strictly increase "
+                        f"({ev['tick']!r} after {last_tick})"
+                    )
+                else:
+                    last_tick = ev["tick"]
+                if ev["action"] not in actions:
+                    errors.append(f"{where}: unknown action {ev['action']!r}")
+                ups += ev["action"] == "scale_up"
+                downs += ev["action"] == "scale_down"
+                sig = ev["signal"]
+                if not isinstance(sig, dict) or sig.get("state") not in states:
+                    errors.append(
+                        f"{where}: signal lacks a legal burn state: "
+                        f"{sig!r:.120}"
+                    )
+                elif not isinstance(sig.get("windows"), list):
+                    errors.append(f"{where}: signal carries no burn windows")
+                elif not (
+                    isinstance(sig.get("replicas"), list) and sig["replicas"]
+                ):
+                    errors.append(
+                        f"{where}: signal carries no per-replica load vector"
+                    )
+            counters = (phase.get("autoscale_metrics") or {}).get(
+                "counters"
+            ) or {}
+            for key, want in (
+                ("autoscale_decisions", len(events)),
+                ("autoscale_scale_ups", ups),
+                ("autoscale_scale_downs", downs),
+            ):
+                if counters.get(key) != want:
+                    errors.append(
+                        f"{tag}: counter {key}={counters.get(key)} "
+                        f"disagrees with the event stream ({want})"
+                    )
+            if ups < 1 or downs < 1:
+                errors.append(
+                    f"{tag}: no full scale cycle in the stream "
+                    f"(ups={ups}, downs={downs})"
+                )
+            if not (phase.get("autoscale_verdict") or {}).get("ok"):
+                errors.append(f"{tag}: autoscale_verdict is not ok")
+            pp = phase.get("metrics_prom_path")
+            if pp:
+                try:
+                    with open(pp) as f:
+                        parsed = parse_prometheus(f.read())
+                except (OSError, ValueError) as e:
+                    errors.append(f"{tag}: exposition unreadable: {e}")
+                else:
+                    for key, v in counters.items():
+                        if not key.startswith("autoscale_"):
+                            continue  # workload/static rows: ledger-only
+                        fam = f"tdx_autoscale_{key[10:]}_total"
+                        got = parsed["samples"].get((fam, ()))
+                        if got != v:
+                            errors.append(
+                                f"{tag}: {fam} is {got} in exposition "
+                                f"but {v} in autoscale_metrics"
+                            )
+            fp = phase.get("flight_path")
+            if fp:
+                errs = validate_flight_jsonl(fp)
+                errors.extend(f"{tag}: {e}" for e in errs)
+                if not errs:
+                    with open(fp) as f:
+                        kinds = [
+                            json.loads(ln).get("kind")
+                            for ln in f.read().splitlines()
+                            if ln.strip()
+                        ]
+                    if kinds.count("scale") < ups + downs:
+                        errors.append(
+                            f"{tag}: flight dump holds "
+                            f"{kinds.count('scale')} scale record(s), "
+                            f"fewer than the {ups + downs} executed "
+                            "actions"
+                        )
+        print(f"autoscale {path}: {n_phases} phase(s), {n_events} decision(s)")
+    if n_phases == 0:
+        errors.append(
+            "no autoscale phase found in any record — was the bench run "
+            "with --scenario/--autoscale?"
+        )
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(
+        f"autoscale artifacts OK ({n_phases} phase(s), "
+        f"{n_events} decision(s))"
+    )
+
+
 def _check_lint_main(paths: list) -> None:
     from torchdistx_tpu.analysis import validate_lint_report
 
@@ -406,6 +564,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--slo":
         _check_slo_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--autoscale":
+        _check_autoscale_main(sys.argv[2:])
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--lint":
         _check_lint_main(sys.argv[2:])

@@ -242,7 +242,12 @@ class TestRouting:
         events = h._request.events
         (routed,) = [e for e in events if e[0] == "routed"]
         (skip,) = [e for e in events if e[0] == "route_skipped"]
-        assert skip[2] == {"rid": fleet.replicas[0].rid, "why": "pages"}
+        # the fleet tick rides every routing event (tick 0 = pre-step)
+        assert skip[2] == {
+            "rid": fleet.replicas[0].rid,
+            "why": "pages",
+            "tick": 0,
+        }
         assert skip[1] == routed[1]  # one decision, one timestamp
         by_rid = {c["replica"]: c for c in routed[2]["candidates"]}
         assert by_rid[fleet.replicas[0].rid]["skip"] == "pages"
@@ -270,6 +275,7 @@ class TestRouting:
         assert skip[2] == {
             "rid": fleet.replicas[0].rid,
             "why": "draining",
+            "tick": 0,
         }
         (routed,) = [e for e in h._request.events if e[0] == "routed"]
         by_rid = {c["replica"]: c for c in routed[2]["candidates"]}

@@ -281,7 +281,10 @@ _SERVE_HIST_TIMINGS = ("ttft_s", "e2e_latency_s", "decode_token_s", "tpot_s")
 #: ``mesh_to`` (the migrate phase's target TP degree) keeps each
 #: source->target shape pair's migration wire-byte pins distinct;
 #: ``fleet``/``disaggregate`` fingerprint the fleet phases' replica
-#: count and prefill/decode split the same way.
+#: count and prefill/decode split the same way;
+#: ``scenario``/``autoscale`` split the open-loop autoscale phases per
+#: traffic scenario and per policy, so an autoscale-on run's scale-event
+#: pins can never collide with autoscale-off rows of the same scenario.
 _SERVE_WORKLOAD_KEYS = (
     "model",
     "requests",
@@ -298,6 +301,8 @@ _SERVE_WORKLOAD_KEYS = (
     "speculate",
     "fleet",
     "disaggregate",
+    "scenario",
+    "autoscale",
 )
 
 
@@ -359,6 +364,13 @@ def ingest_serve_record(record: dict, **kw) -> List[dict]:
 
         m = phase.get("metrics") or {}
         for name, v in (m.get("counters") or {}).items():
+            row(name, v, "counter")
+        # the autoscale A/B's own block (kept OUT of ``metrics`` so the
+        # exposition-projection gate stays exact): controller decision
+        # counters, the scenario's workload shape, and both sides'
+        # tick-space attainment/cost axes — all integers, exact pins
+        am = phase.get("autoscale_metrics") or {}
+        for name, v in (am.get("counters") or {}).items():
             row(name, v, "counter")
         derived = m.get("derived") or {}
         # counter-derived exact ratios (host_syncs / tokens etc.): same

@@ -566,3 +566,67 @@ def fleet_request_trace_events(
                 }
             )
     return out
+
+
+_FLEET_TRACK_PID = _FLEET_PID_BASE - 1  # the fleet-wide control track
+
+
+def fleet_scale_trace_events(events) -> List[dict]:
+    """Fleet control-plane instants for ``ServeFleet.dump_trace``: every
+    scale/role/add/remove entry of ``fleet.events`` as a Perfetto
+    instant on a dedicated "fleet" process track, so a trace answers
+    "what did the autoscaler do, and when, relative to the request
+    chains" on one timeline.  Autoscale decisions render as
+    ``scale:<action>`` with a COMPACT arg set (tick, action, replica,
+    burn state, reason) — the full signal vector stays in
+    ``fleet.events`` and the flight record, where schema checks read
+    it.  Timestamps stay absolute monotonic seconds; pass the result to
+    :meth:`Tracer.export` as ``extra_events``."""
+    picked = [
+        (name, ts, data)
+        for name, ts, data in events
+        if name in ("scale", "role", "add", "remove")
+    ]
+    if not picked:
+        return []
+    out: List[dict] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": _FLEET_TRACK_PID,
+            "tid": 0,
+            "args": {"name": "fleet"},
+        }
+    ]
+    for name, ts, data in picked:
+        data = data or {}
+        if name == "scale":
+            label = f"scale:{data.get('action', '?')}"
+            args = {
+                "tick": data.get("tick"),
+                "action": data.get("action"),
+                "mode": data.get("mode"),
+                "replica": data.get("replica"),
+                "state": (data.get("signal") or {}).get("state"),
+                "reason": data.get("reason"),
+            }
+        else:
+            label = name
+            args = {
+                k: v
+                for k, v in data.items()
+                if isinstance(v, (int, float, str, bool, type(None)))
+            }
+        out.append(
+            {
+                "ph": "i",
+                "name": label,
+                "cat": "fleet",
+                "pid": _FLEET_TRACK_PID,
+                "tid": 0,
+                "ts": ts,
+                "s": "p",
+                "args": args,
+            }
+        )
+    return out

@@ -10,9 +10,15 @@ max-tokens budget, free-page gating, and per-request deadlines
 with chunked (fused K-step scan) or persistent (whole-generation
 ``lax.while_loop`` + device output ring, host syncs ~0) decode
 (:mod:`~torchdistx_tpu.serve.engine`), plain-dict metrics
-(:mod:`~torchdistx_tpu.serve.metrics`), and a prefix-affinity fleet
+(:mod:`~torchdistx_tpu.serve.metrics`), a prefix-affinity fleet
 router over N engine replicas with drain/scale events and optional
-prefill/decode disaggregation (:mod:`~torchdistx_tpu.serve.fleet`).
+prefill/decode disaggregation (:mod:`~torchdistx_tpu.serve.fleet`),
+a closed-loop autoscaler mapping burn states to warmed adds /
+DistServe re-roles / zero-drop removes
+(:mod:`~torchdistx_tpu.serve.autoscale`), and a deterministic
+open-loop traffic generator whose every sample comes from the
+``utils/rng.py`` counter stream
+(:mod:`~torchdistx_tpu.serve.workload`).
 
 Observability (docs/observability.md): every request carries a
 lifecycle event log, the engine exports per-request Perfetto traces
@@ -21,6 +27,13 @@ the metric set in Prometheus text format through
 :mod:`torchdistx_tpu.obs`.
 """
 
+from .autoscale import (
+    AutoscaleController,
+    LoadSignal,
+    ScalingPolicy,
+    replay_signal,
+    slo_burn_signal,
+)
 from .engine import ServeEngine
 from .fleet import (
     AffinityPolicy,
@@ -32,6 +45,14 @@ from .kv_cache import PagedKVCache, SlotKVCache
 from .metrics import Histogram, ServeMetrics
 from .prefix_cache import PagePool, RadixPrefixIndex
 from .scheduler import Request, RequestHandle, RequestResult, Scheduler
+from .workload import (
+    SCENARIOS,
+    ScenarioSpec,
+    SyntheticRequest,
+    generate,
+    scenario,
+    workload_counters,
+)
 
 __all__ = [
     "ServeEngine",
@@ -39,6 +60,17 @@ __all__ = [
     "AffinityPolicy",
     "LeastLoadedPolicy",
     "RoundRobinPolicy",
+    "AutoscaleController",
+    "ScalingPolicy",
+    "LoadSignal",
+    "slo_burn_signal",
+    "replay_signal",
+    "ScenarioSpec",
+    "SyntheticRequest",
+    "SCENARIOS",
+    "scenario",
+    "generate",
+    "workload_counters",
     "SlotKVCache",
     "PagedKVCache",
     "PagePool",

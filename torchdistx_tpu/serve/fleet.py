@@ -305,9 +305,16 @@ class ServeFleet:
                 self._check_compat(rep)
         self.policy = self._resolve_policy(policy)
         #: fleet-level lifecycle event log: (name, monotonic_ts, data) —
-        #: routed/handoff/remove/add, the fleet analog of the request
-        #: event log (exported by the bench phase's record)
+        #: routed/handoff/remove/add/role/scale, the fleet analog of the
+        #: request event log (exported by the bench phase's record)
         self.events: List[tuple] = []
+        #: monotonic tick counter: incremented at the START of every
+        #: :meth:`step`, threaded into every fleet/request event's data
+        #: (``"tick"``) so an event correlates to the exact tick whose
+        #: windowed state (rejection tie-breaks, autoscale sustain runs)
+        #: it was decided under.  Submissions between step N and N+1
+        #: carry tick N; tick 0 is "before the first step".
+        self.tick: int = 0
         # counters of replicas removed from rotation: a Prometheus
         # counter must never decrease, so a retired replica's totals
         # (its migrations out included) stay in the fleet aggregate
@@ -450,16 +457,17 @@ class ServeFleet:
         policy = getattr(self.policy, "name", "custom")
         for rid_skipped, why in skipped:
             req.record_event(
-                "route_skipped", ts=now, rid=rid_skipped, why=why
+                "route_skipped", ts=now, rid=rid_skipped, why=why,
+                tick=self.tick,
             )
         req.record_event(
             "routed", ts=now, replica=rep.rid, policy=policy,
-            candidates=scored,
+            candidates=scored, tick=self.tick,
         )
         self.events.append(
             ("routed", now,
              {"rid": handle.rid, "trace_id": handle.trace_id,
-              "replica": rep.rid, "policy": policy,
+              "replica": rep.rid, "policy": policy, "tick": self.tick,
               "candidates": scored})
         )
         return handle
@@ -476,6 +484,7 @@ class ServeFleet:
         spinning, see ``_check_ever_placeable``), then decode replicas
         take their decode ``step()``.  Returns total unfinished
         requests across the fleet."""
+        self.tick += 1
         for rep in self._replicas:
             rep.snapshot_rejections()  # roll the tie-break window
         unfinished = 0
@@ -512,7 +521,8 @@ class ServeFleet:
                 self.events.append(
                     ("handoff", time.monotonic(),
                      {"rid": req.rid, "trace_id": req.trace_id,
-                      "from": rep.rid, "to": tgt.rid, **info})
+                      "from": rep.rid, "to": tgt.rid,
+                      "tick": self.tick, **info})
                 )
 
     @staticmethod
@@ -640,7 +650,7 @@ class ServeFleet:
             for req in rep.engine.finished_requests()
         )
         self._replicas.remove(rep)
-        out = {**summary, "replica": rep.rid, "to": to}
+        out = {**summary, "replica": rep.rid, "to": to, "tick": self.tick}
         self.events.append(("remove", time.monotonic(), out))
         return out
 
@@ -754,11 +764,29 @@ class ServeFleet:
         }
         return summary, sorted(set(dest_rids))
 
-    def add(self, engine: ServeEngine, *, role: Optional[str] = None) -> int:
+    def add(
+        self,
+        engine: ServeEngine,
+        *,
+        role: Optional[str] = None,
+        warm: bool = True,
+    ) -> int:
         """Warm a new replica into rotation; returns its stable rid.
         ``role`` defaults to ``"serve"`` (aggregated) / ``"decode"``
         (disaggregated); disaggregated adds are KV-compat-validated the
-        same way the constructor validates."""
+        same way the constructor validates.
+
+        ``warm=True`` (the default) runs throwaway requests through the
+        engine's reachable compiled programs BEFORE it enters rotation —
+        every prefill bucket plus the decode path, each twice, so the
+        warm-prefix paged program and the donated-carry second-dispatch
+        decode recompile (CLAUDE.md) are behind it — then evicts the
+        warm-up's prefix-index entries, clears its finished history, and
+        resets its metrics.  A scale-up therefore never serves its first
+        routed request through a compile stall, and the fleet's
+        ``recompile`` counters stay flat across the scale-up tick
+        (pinned in tests/test_autoscale.py).  Engines that already hold
+        work or history are never warmed (the elastic re-add path)."""
         if role is None:
             role = "decode" if self.disaggregate else "serve"
         if role not in _ROLES:
@@ -780,10 +808,108 @@ class ServeFleet:
             except ValueError:
                 self._replicas.remove(rep)
                 raise
+        warm_info = self._warm_engine(engine) if warm else None
+        rep.snapshot_rejections()  # warm-up gatings never bias routing
         self.events.append(
-            ("add", time.monotonic(), {"replica": rep.rid, "role": role})
+            ("add", time.monotonic(),
+             {"replica": rep.rid, "role": role, "tick": self.tick,
+              "warm": warm_info})
         )
         return rep.rid
+
+    @staticmethod
+    def _warm_engine(engine: ServeEngine) -> dict:
+        """Compile-warm a fresh engine (see :meth:`add`): two identical
+        throwaway generations per prefill bucket — the second pass hits
+        the warm-prefix program on paged engines and the donated-carry
+        decode recompile on donation-capable backends — attributed to
+        ``fleet/add_warmup`` in the recompile watcher, then every trace
+        of the warm-up is scrubbed (prefix pages evicted, finished
+        history cleared, metrics reset) so routed traffic sees a clean
+        replica whose programs are simply already compiled."""
+        import numpy as np
+
+        from ..obs.recompile import recompile_scope
+
+        if engine.scheduler.has_work() or engine.finished_requests():
+            return {"skipped": "engine has prior work/history"}
+        before = engine.num_compiled_programs()
+        new_tokens = max(1, min(2, engine.max_len - 1))
+        prompts = [
+            np.zeros(
+                (max(1, min(bucket, engine.max_len - new_tokens)),),
+                dtype=np.int32,
+            )
+            for bucket in engine.prefill_buckets
+        ]
+        with recompile_scope("fleet/add_warmup"):
+            for _ in range(2):
+                engine.run(
+                    [
+                        {
+                            "prompt": p.copy(),
+                            "max_new_tokens": new_tokens,
+                        }
+                        for p in prompts
+                    ]
+                )
+        if engine.paged and engine.prefix_index is not None:
+            engine.prefix_index.evict(engine.pool, engine.pool.capacity)
+        engine._finished.clear()
+        engine.reset_metrics()
+        return {
+            "programs_before": before,
+            "programs_after": engine.num_compiled_programs(),
+            "requests": 2 * len(prompts),
+        }
+
+    def reassign_role(self, rid: int, role: str) -> dict:
+        """DistServe-style re-roling: flip an IDLE replica between
+        ``prefill`` and ``decode`` without rebuilding its engine — the
+        autoscaler's cheap scale-up when the prefill side has headroom
+        (arXiv:2401.09670's resource-reallocation move).  Requires a
+        disaggregated fleet, an idle replica (no queued or running
+        work), and that the flip leaves at least one replica in the old
+        role; a flip INTO prefill re-validates KV compatibility the way
+        the constructor does.  Emits a ``("role", ...)`` fleet event and
+        returns its data."""
+        if not self.disaggregate:
+            raise RuntimeError(
+                "reassign_role requires a disaggregated fleet"
+            )
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"unknown role {role!r}; use ('prefill', 'decode')"
+            )
+        rep = self._get(rid)
+        if rep.role == role:
+            raise ValueError(f"replica {rid} already has role {role!r}")
+        if rep.engine.scheduler.has_work():
+            raise RuntimeError(
+                f"replica {rid} holds work — a re-role would strand its "
+                "requests; drain first or pick an idle replica"
+            )
+        if len(self._by_role(rep.role)) <= 1:
+            raise RuntimeError(
+                f"cannot re-role replica {rid}: it is the last "
+                f"{rep.role!r} replica in the fleet"
+            )
+        old = rep.role
+        rep.role = role
+        try:
+            for pre in self._by_role("prefill"):
+                self._check_compat(pre)
+        except ValueError:
+            rep.role = old
+            raise
+        data = {
+            "replica": rep.rid,
+            "from": old,
+            "to": role,
+            "tick": self.tick,
+        }
+        self.events.append(("role", time.monotonic(), data))
+        return data
 
     # -- observability -----------------------------------------------------
 
@@ -814,8 +940,16 @@ class ServeFleet:
         (``route -> queued -> prefill -> handoff -> decode``) keyed on
         its process-unique ``trace_id`` (``obs.trace.
         fleet_request_trace_events``).  Open in ui.perfetto.dev; gate
-        with ``scripts/check_obs_artifacts.py --slo``."""
-        from ..obs.trace import fleet_request_trace_events, get_tracer
+        with ``scripts/check_obs_artifacts.py --slo``.  Fleet-level
+        control-plane events — autoscale decisions, role flips, adds,
+        removes — render as instants on a dedicated "fleet" track
+        (``obs.trace.fleet_scale_trace_events``), correlated by the
+        shared timebase and the ``tick`` each instant carries."""
+        from ..obs.trace import (
+            fleet_request_trace_events,
+            fleet_scale_trace_events,
+            get_tracer,
+        )
 
         finished = []
         roles = {}
@@ -828,7 +962,8 @@ class ServeFleet:
             finished.append((rid, role, req))
         return get_tracer().export(
             path,
-            extra_events=fleet_request_trace_events(finished, roles=roles),
+            extra_events=fleet_request_trace_events(finished, roles=roles)
+            + fleet_scale_trace_events(self.events),
         )
 
     # -- metrics ----------------------------------------------------------

@@ -12,11 +12,19 @@ all — the key is an ordinary closure constant in the recorded op.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import struct
 import threading
 
 import jax
 
-__all__ = ["manual_seed", "next_rng_key", "rng_scope", "current_seed"]
+__all__ = [
+    "manual_seed",
+    "next_rng_key",
+    "next_host_uniform",
+    "rng_scope",
+    "current_seed",
+]
 
 
 class _RngState(threading.local):
@@ -57,6 +65,25 @@ def next_rng_key() -> jax.Array:
         key = jax.random.fold_in(_state.root, _state.counter)
     _state.counter += 1
     return key
+
+
+def next_host_uniform() -> float:
+    """Next sample in ``[0, 1)`` from the SAME counter stream, drawn
+    entirely host-side (SHA-256 of ``(seed, counter)`` — no jax dispatch,
+    no device, no interposition concerns).  Advances the same
+    ``_state.counter`` as :func:`next_rng_key`, so host draws and key
+    draws interleave into one deterministic sequence: same seed, same
+    call order ⇒ bit-identical samples on every platform.  Built for
+    high-volume host-side simulation (``serve/workload.py``'s open-loop
+    traffic generator) where per-sample jax keys would dominate the
+    generator's cost and a stateful ``np.random`` stream would break the
+    repo's replay contract (lint rule TDX102)."""
+    digest = hashlib.sha256(
+        struct.pack("<qq", _state.seed, _state.counter)
+    ).digest()
+    _state.counter += 1
+    # 53 explicitly-placed mantissa bits, the float64 uniform convention
+    return (int.from_bytes(digest[:8], "little") >> 11) * (2.0 ** -53)
 
 
 @contextlib.contextmanager
