@@ -23,7 +23,14 @@ populated by the cold pass).  Warm prefill must compute strictly fewer padded
 tokens than cold (suffix-only prefill); the phase reports both passes'
 full metrics (``ServeMetrics.to_json()``) plus the warm prefix hit-rate
 and pages-in-use high water, and flags ``error`` when the inequality
-fails (so ``TDX_SERVE_STRICT`` CI catches a broken prefix cache).  Each
+fails (so ``TDX_SERVE_STRICT`` CI catches a broken prefix cache); then —
+with ``--kv-dtype`` (every phase's engines store KV quantized) or
+``--kv-quant-ab`` (only the A/B phase; default phases untouched) — the
+``kv_quant`` phase: a bfloat16-cache baseline vs the quantized engine on
+one greedy workload, STRICT on the exactly-halved ``memory_plan()`` KV
+pool (int8), the pinned stream-divergence tolerance against the
+model-dtype oracle, decode tok/s, and strictly-lower decode-program
+``bytes_accessed``.  Each
 phase embeds ``engine.metrics.to_json()`` verbatim under ``"metrics"`` —
 one schema for tests, bench, and CI to parse — plus the recompile
 watcher's counters (``recompile_warmup`` / ``recompile_measure``: XLA
@@ -230,6 +237,30 @@ def _parse_args():
         "injected-burn leg's contract",
     )
     ap.add_argument(
+        "--kv-dtype",
+        default=None,
+        metavar="DTYPE",
+        help="KV-cache storage dtype for EVERY phase's engines (int8 "
+        "quantizes on write with per-row power-of-two scales; bfloat16/"
+        "float16/float32 cast).  Also appends the kv_quant A/B phase: "
+        "a bfloat16-baseline vs --kv-dtype engine pair on the same "
+        "greedy workload, STRICT on the halved memory_plan() KV pool, "
+        "the pinned stream-divergence tolerance, decode tok/s, and a "
+        "strictly-lower cost-card bytes_accessed for every decode "
+        "program.  Phase records gain a 'kv_dtype' ledger workload key "
+        "(only when set — default-run fingerprints never drift)",
+    )
+    ap.add_argument(
+        "--kv-quant-ab",
+        default=None,
+        metavar="DTYPE",
+        help="append ONLY the kv_quant A/B phase at this quantized dtype "
+        "while every other phase keeps its default (model-dtype) cache — "
+        "the nightly default-smoke rider: existing fingerprints stay "
+        "byte-stable and the record gains the int8 family.  Use "
+        "--kv-dtype instead to run the WHOLE sweep quantized",
+    )
+    ap.add_argument(
         "--artifact",
         default=None,
         help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
@@ -345,6 +376,16 @@ def _phase_summary(rec: dict) -> dict:
             ttft_p50_s_affinity=rec.get("ttft_p50_s_affinity"),
             ttft_p50_s_round_robin=rec.get("ttft_p50_s_round_robin"),
             streams_identical=rec.get("streams_identical"),
+        )
+    if "kv_bytes_factor" in rec:  # the kv_quant A/B phase
+        out.update(
+            kv_dtype=rec.get("kv_dtype"),
+            kv_bytes_factor=rec.get("kv_bytes_factor"),
+            stream_prefix_agreement=rec.get("stream_prefix_agreement"),
+            streams_identical_frac=rec.get("streams_identical_frac"),
+            decode_tokens_per_sec_baseline=rec.get(
+                "decode_tokens_per_sec_baseline"
+            ),
         )
     if "remove_summary" in rec:  # the fleet drain leg
         out.update(
@@ -472,6 +513,16 @@ def _supervise(args) -> None:
                 {
                     "TDX_SERVE_CHUNK": str(chunks[-1]),
                     "TDX_SERVE_PHASE": "migrate",
+                },
+            )
+        )
+    if args.kv_dtype or args.kv_quant_ab:
+        plan.append(
+            (
+                "kv_quant",
+                {
+                    "TDX_SERVE_CHUNK": str(chunks[-1]),
+                    "TDX_SERVE_PHASE": "kv_quant",
                 },
             )
         )
@@ -716,6 +767,10 @@ def _phase_setup(args, **extra) -> tuple:
         "mesh": args.tp,
         **extra,
     }
+    if args.kv_dtype:
+        # a ledger workload key ONLY when requested: int8 fingerprints
+        # get their own family while default-run pins stay byte-stable
+        record["kv_dtype"] = args.kv_dtype
     return record, name, k_chunk, plat
 
 
@@ -738,6 +793,32 @@ def _mesh_kwargs(args, tp: int = None) -> dict:
             f"--tp {tp} needs {tp} devices, found {len(devs)}"
         )
     return {"mesh": Mesh(np.asarray(devs[:tp]), ("tp",))}
+
+
+def _kv_kwargs(args, kv_dtype: str = None) -> dict:
+    """``ServeEngine(kv_dtype=...)`` kwargs (empty without ``--kv-dtype``,
+    so default phases build byte-identical engines).  ``kv_dtype``
+    overrides ``args.kv_dtype`` — the kv_quant phase builds its bfloat16
+    baseline engine beside the quantized one."""
+    kv = args.kv_dtype if kv_dtype is None else kv_dtype
+    return {"kv_dtype": kv} if kv else {}
+
+
+def _kv_entry_wire_bytes(entry, g: int) -> int:
+    """Ring all-gather wire for ONE slot row (or page) of one layer's
+    full cache entry at gather group ``g``: ``unit * (g-1)/g`` summed
+    per array — the ``(k, v)`` pair, plus the f32 scale arrays when the
+    cache is quantized, each priced at its OWN dtype (the int8 closed
+    form's dtype factor)."""
+    import numpy as np
+
+    if g <= 1:
+        return 0
+    total = 0
+    for a in entry:
+        unit = int(np.prod(a.shape[1:])) * np.dtype(a.dtype).itemsize
+        total += unit * (g - 1) // g
+    return total
 
 
 def _embed_cost(record: dict, engine) -> None:
@@ -881,6 +962,7 @@ def _child(args) -> None:
             max_len=max_len,
             **engine_kw,
             **_mesh_kwargs(args),
+            **_kv_kwargs(args),
         )
         if persistent:
             record["ring_capacity"] = engine.ring_capacity
@@ -1009,6 +1091,7 @@ def _child_spec(args) -> None:
             max_len=max_len,
             **engine_kw,
             **_mesh_kwargs(args),
+            **_kv_kwargs(args),
         )
         record["ring_capacity"] = engine.ring_capacity
         record["max_new_tokens"] = spec_new
@@ -1119,6 +1202,7 @@ def _child_prefix(args) -> None:
             decode_chunk=k_chunk,
             page_size=ps,
             **_mesh_kwargs(args),
+            **_kv_kwargs(args),
         )
         # the production shape: every request opens with the same long
         # system prompt, tails differ
@@ -1322,6 +1406,7 @@ def _child_chunked_prefill(args) -> None:
                 prefill_buckets=buckets,
                 chunked_prefill=t_chunk if chunked else None,
                 **_mesh_kwargs(args),
+                **_kv_kwargs(args),
             )
             # warm both prefill buckets (+ the chunked warm-prefill
             # program) and the decode program past the donated-carry
@@ -1443,6 +1528,7 @@ def _child_migrate(args) -> None:
                 decode_chunk=k_chunk,
                 prefill_buckets=(bucket,),
                 **_mesh_kwargs(args, tp=tp),
+                **_kv_kwargs(args),
             )
 
         # undrained reference on the source shape: the bit-identity oracle
@@ -1476,15 +1562,14 @@ def _child_migrate(args) -> None:
         record["comm"] = prof.to_json()
         # the ring closed form, computed independently of the engine:
         # gather group g = tp_from / gcd(tp_from, tp_to), one all-gather
-        # per migrated slot row per layer per k/v array at unit*(g-1)/g
-        kv0 = src.cache.kv[0][0]
-        unit = int(np.prod(kv0.shape[1:])) * np.dtype(kv0.dtype).itemsize
+        # per migrated slot row per layer per cache array at unit*(g-1)/g
+        # — summed over the layer's FULL entry (k/v plus the f32 scale
+        # arrays of a quantized cache, each at its own dtype width)
         g = max(1, args.tp // int(np.gcd(args.tp, tp_to)))
         expect = (
             summary["migrated_running"]
-            * len(src.cache.kv) * 2 * (unit * (g - 1) // g)
-            if g > 1
-            else 0
+            * len(src.cache.kv)
+            * _kv_entry_wire_bytes(src.cache.kv[0], g)
         )
         # the target finishes the streams, so its metrics are the phase
         # metrics; graft the source-side migration counters in so ONE
@@ -1521,6 +1606,192 @@ def _child_migrate(args) -> None:
                 f"the migration summary {summary['wire_bytes']}"
             )
         _dump_obs(record, dst, "migrate")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
+def _child_kv_quant(args) -> None:
+    """The ``--kv-dtype`` A/B (ISSUE 17 tentpole evidence): one
+    bfloat16-cache baseline engine and one ``--kv-dtype`` engine serve
+    the SAME greedy workload, and the phase flags ``error`` unless
+    (int8) the ``memory_plan()`` KV pool is EXACTLY halved (the
+    double-the-pages factor at a constant byte budget), the greedy
+    streams stay within the pinned divergence tolerance against the
+    model-dtype oracle (``TDX_KV_QUANT_STREAM_TOL``, mean
+    longest-common-prefix fraction), decode tok/s holds the baseline
+    (``TDX_KV_QUANT_TOKS_SLACK`` — CPU-smoke timing noise gets slack,
+    the TPU leg runs tight), and every decode program's cost-card
+    ``bytes_accessed`` is STRICTLY lower than its baseline twin (the
+    halved-HBM-traffic claim, priced by XLA, not assumed)."""
+    record, name, k_chunk, plat = _phase_setup(args, phase="kv_quant")
+    kv_dtype = args.kv_dtype or args.kv_quant_ab or "int8"
+    record["kv_dtype"] = kv_dtype
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        n_req = max(2, min(args.requests, 2 * args.slots))
+        rs = np.random.RandomState(5)
+        max_prompt = max(1, min(max_len - args.max_new, max_len // 2))
+        work = [
+            dict(
+                prompt=rs.randint(0, 256, (int(n),)).astype(np.int32),
+                max_new_tokens=args.max_new,
+                temperature=0.0,  # the verdict IS greedy-argmax robustness
+            )
+            for n in rs.randint(1, max_prompt + 1, n_req)
+        ]
+        record["max_len"] = max_len
+
+        def build(kv):
+            # kv=None is the MODEL-dtype oracle — never fall back to
+            # --kv-dtype here (that leg must stay unquantized)
+            return ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                kv_dtype=kv,
+                **_mesh_kwargs(args),
+            )
+
+        def measure(engine):
+            # warm past the donated-carry second-call recompile (two
+            # serial runs), then measure steady-state dispatch only
+            for _ in range(2):
+                engine.run([dict(w) for w in work])
+            engine.reset_metrics()
+            out = engine.run([dict(w) for w in work])
+            return [r.tokens for r in out]
+
+        base = build("bfloat16")
+        quant = build(kv_dtype)
+        base_tokens = measure(base)
+        quant_tokens = measure(quant)
+
+        # the divergence oracle is the MODEL-dtype cache (f32 on the CPU
+        # smoke); when the model already runs bf16 the baseline IS the
+        # oracle and the third run would duplicate it
+        if base.cache.kv[0][0].dtype == np.dtype(model.cfg.dtype):
+            ref_tokens = base_tokens
+        else:
+            ref_tokens = measure(build(None))
+
+        def lcp_frac(a, b):
+            a, b = np.asarray(a), np.asarray(b)
+            n = min(a.size, b.size)
+            neq = np.nonzero(a[:n] != b[:n])[0]
+            lcp = int(neq[0]) if neq.size else n
+            return lcp / max(1, max(a.size, b.size))
+
+        fracs = [lcp_frac(q, r) for q, r in zip(quant_tokens, ref_tokens)]
+        agreement = float(np.mean(fracs)) if fracs else 1.0
+        identical = sum(
+            np.array_equal(q, r) for q, r in zip(quant_tokens, ref_tokens)
+        )
+        record["stream_prefix_agreement"] = round(agreement, 4)
+        record["streams_identical_frac"] = round(identical / n_req, 4)
+
+        plan_base = base.memory_plan()
+        plan_quant = quant.memory_plan()
+        record["memory_plan"] = plan_quant
+        record["memory_plan_baseline"] = plan_base
+        kv_base = plan_base["components"]["kv_cache"]
+        kv_quant = plan_quant["components"]["kv_cache"]
+        # data-plane halving == doubled page capacity at a constant HBM
+        # budget; the f32 scale sidecar is priced separately (kv_scales)
+        record["kv_bytes_factor"] = round(kv_base / kv_quant, 4)
+
+        mb = base.metrics.to_json()
+        mq = quant.metrics.to_json()
+        record["metrics"] = mq
+        record["metrics_baseline"] = mb
+        toks_base = (mb["derived"] or {}).get("decode_tokens_per_sec")
+        toks_quant = (mq["derived"] or {}).get("decode_tokens_per_sec")
+        record["decode_tokens_per_sec_baseline"] = toks_base
+
+        _embed_cost(record, quant)
+        cards_base = base.cost_book.to_json()
+        cards_quant = quant.cost_book.to_json()
+        decode_bytes = {}
+        for prog, cq in sorted(cards_quant.items()):
+            if not prog.startswith("serve/decode"):
+                continue
+            cb = cards_base.get(prog) or {}
+            decode_bytes[prog] = {
+                "bytes_accessed": cq.get("bytes_accessed"),
+                "bytes_accessed_baseline": cb.get("bytes_accessed"),
+            }
+        record["decode_bytes_accessed"] = decode_bytes
+
+        stream_tol = float(
+            os.environ.get("TDX_KV_QUANT_STREAM_TOL", "0.5")
+        )
+        # CPU interpret-mode dequant is real ALU work with no HBM saving
+        # to offset it (and tiny-workload timings are noisy), so the CPU
+        # smoke gets a sanity floor; the TPU leg — where the halved HBM
+        # read is the point — runs tight
+        toks_slack = float(
+            os.environ.get(
+                "TDX_KV_QUANT_TOKS_SLACK",
+                "0.5" if record["platform"] == "cpu" else "0.05",
+            )
+        )
+        record["stream_tol"] = stream_tol
+        record["toks_slack"] = toks_slack
+        not_priced = [
+            p
+            for p, d in decode_bytes.items()
+            if not (
+                d["bytes_accessed"] and d["bytes_accessed_baseline"]
+            )
+        ]
+        if kv_dtype == "int8" and kv_quant * 2 != kv_base:
+            record["error"] = (
+                f"int8 KV pool {kv_quant} B is not exactly half the "
+                f"bfloat16 pool {kv_base} B in memory_plan()"
+            )
+        elif agreement < stream_tol:
+            record["error"] = (
+                f"greedy stream prefix agreement {agreement:.3f} below "
+                f"the pinned tolerance {stream_tol}"
+            )
+        elif not (toks_base and toks_quant):
+            record["error"] = "a leg produced no decode throughput figure"
+        elif toks_quant < toks_base * (1.0 - toks_slack):
+            record["error"] = (
+                f"quantized decode {toks_quant:.1f} tok/s fell below the "
+                f"baseline {toks_base:.1f} beyond the {toks_slack} slack"
+            )
+        elif not decode_bytes:
+            record["error"] = (
+                "no decode cost cards — the bytes_accessed verdict has "
+                "no evidence (is TDX_COST_CARDS off?)"
+            )
+        elif not_priced:
+            record["error"] = (
+                f"decode programs missing bytes_accessed: {not_priced}"
+            )
+        elif not all(
+            d["bytes_accessed"] < d["bytes_accessed_baseline"]
+            for d in decode_bytes.values()
+        ):
+            worst = {
+                p: (d["bytes_accessed"], d["bytes_accessed_baseline"])
+                for p, d in decode_bytes.items()
+                if d["bytes_accessed"] >= d["bytes_accessed_baseline"]
+            }
+            record["error"] = (
+                "a quantized decode program reads at least as many bytes "
+                f"as its bfloat16 twin: {worst}"
+            )
+        _dump_obs(record, quant, "kv_quant")
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -1703,6 +1974,7 @@ def _child_fleet(args) -> None:
                 prefill_buckets=(bucket,),
                 page_size=ps,
                 **_mesh_kwargs(args),
+                **_kv_kwargs(args),
             )
 
         # the bit-identity oracle: one engine, same requests
@@ -1842,6 +2114,7 @@ def _child_fleet_drain(args) -> None:
                 decode_chunk=k_chunk,
                 prefill_buckets=(bucket,),
                 **_mesh_kwargs(args),
+                **_kv_kwargs(args),
             )
 
         ref_tokens = [r.tokens for r in build().run([dict(w) for w in work])]
@@ -1958,6 +2231,7 @@ def _child_fleet_disagg(args) -> None:
                 decode_chunk=k_chunk,
                 prefill_buckets=(bucket,),
                 **_mesh_kwargs(args, tp=tp),
+                **_kv_kwargs(args),
             )
 
         ref_tokens = [
@@ -1978,11 +2252,15 @@ def _child_fleet_disagg(args) -> None:
         )
         record["streams_identical"] = streams_equal
         record["comm"] = prof.to_json()
-        # the ring closed form, computed independently of the engine
-        kv0 = pre.cache.kv[0][0]
-        unit = int(np.prod(kv0.shape[1:])) * np.dtype(kv0.dtype).itemsize
+        # the ring closed form, computed independently of the engine —
+        # per-array dtype widths over the full entry tuple, so a
+        # quantized pool prices int8 data + f32 scales exactly
         g = max(1, tp_pre // int(np.gcd(tp_pre, tp_dec)))
-        expect = n_req * len(pre.cache.kv) * 2 * (unit * (g - 1) // g)
+        expect = (
+            n_req
+            * len(pre.cache.kv)
+            * _kv_entry_wire_bytes(pre.cache.kv[0], g)
+        )
         record["handoff_wire_bytes_expected"] = expect
         record["metrics"] = fleet.metrics_json()
         slo_rep = _eval_slo(args, fleet.finished_requests())
@@ -2089,6 +2367,7 @@ def _child_autoscale(args) -> None:
                 decode_chunk=k_chunk,
                 prefill_buckets=(bucket,),
                 **_mesh_kwargs(args),
+                **_kv_kwargs(args),
             )
 
         watcher = obs.RecompileWatcher()
@@ -2283,6 +2562,8 @@ def main() -> None:
             _child_spec(args)
         elif phase == "migrate":
             _child_migrate(args)
+        elif phase == "kv_quant":
+            _child_kv_quant(args)
         elif phase == "fleet":
             _child_fleet(args)
         elif phase == "fleet_drain":

@@ -112,6 +112,21 @@ def _steps() -> list:
              "--artifact", "/tmp/BENCH_SERVE_CPU_SPEC.json"] if smoke
             else ["--artifact", "BENCH_SERVE_TPU_SPEC.json"]),
          {} if smoke else {"TDX_BENCH_DEADLINE": "700"}, 800),
+        # int8 KV-cache A/B (ISSUE 17): the WHOLE sweep quantized
+        # (--kv-dtype plumbs int8 into every phase's engines) — the
+        # kv_quant phase's strict verdict (halved memory_plan() KV pool,
+        # pinned greedy-stream divergence, decode tok/s vs the bfloat16
+        # baseline, strictly-lower decode bytes_accessed) is the first
+        # on-chip pricing of half-width KV against real HBM bandwidth.
+        # Own artifact for the same clobber reason as serve_spec_ab.
+        ("serve_kv_quant_ab",
+         [py, os.path.join(sdir, "bench_serve.py"),
+          "--kv-dtype", "int8", "--decode-mode", "chunked"]
+         + (["--decode-chunk", "4", "--requests", "6", "--max-new", "8",
+             "--slots", "2", "--max-len", "64",
+             "--artifact", "/tmp/BENCH_SERVE_CPU_KVQUANT.json"] if smoke
+            else ["--artifact", "BENCH_SERVE_TPU_KVQUANT.json"]),
+         {} if smoke else {"TDX_BENCH_DEADLINE": "700"}, 800),
         ("flash_long_context",
          [py, os.path.join(sdir, "bench_flash_attention.py")]
          + (["--seqs", "256"] if smoke else

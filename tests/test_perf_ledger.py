@@ -631,8 +631,15 @@ class TestCLIs:
         # k1 + k4 + persistent + the ISSUE 11 speculate sweep (spec0
         # baseline rides at its own geometry — the spec phases stretch
         # max_new so the self-repetition the n-gram drafter needs can
-        # establish, hence their own fingerprint family)
-        assert len(phase_fps) == 6
+        # establish, hence their own fingerprint family) + the ISSUE 17
+        # int8 --kv-quant-ab rider (kv_dtype=int8 tags its fingerprint,
+        # so the quantized family never collides with the default pins)
+        assert len(phase_fps) == 7
+        kvq_fps = {fp for fp in phase_fps if "phase=kv_quant" in fp}
+        assert len(kvq_fps) == 1 and "kv_dtype=int8" in next(iter(kvq_fps))
+        assert any(
+            "kv_dtype=int8" in fp for fp in fps if "program=serve/" in fp
+        )
         for fp in fps:
             assert "requests=6" in fp
             assert "model=tiny" in fp and "num_slots=2" in fp

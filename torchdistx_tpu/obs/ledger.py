@@ -299,6 +299,7 @@ _SERVE_WORKLOAD_KEYS = (
     "mesh_to",
     "chunked_prefill",
     "speculate",
+    "kv_dtype",
     "fleet",
     "disaggregate",
     "scenario",

@@ -301,11 +301,30 @@ def slot_cached_attention(
     (``_slot_attend``) — a gather reproduces the slab's visible values
     bitwise, so paged and contiguous greedy streams are bit-identical
     (the engine-level contract tests/test_serve.py pins).
+
+    **Quantized cache** (``ServeEngine(kv_dtype="int8")``): ``cache`` is
+    the 4-tuple ``(k, v, k_scale, v_scale)`` — int8 data plus f32
+    per-row per-head scales (``serve/kv_cache.py``).  New K/V quantize
+    on write (data and scale rows ride the same scatter indices), the
+    pallas kernels dequantize blocks as they stream through VMEM
+    (``k_scale=``/``v_scale=`` operands), and the jnp paths attend the
+    dequantized view — kernel-vs-jnp parity therefore holds with the
+    SAME bounds as the f32 cache, both paths reading identical
+    dequantized values.  Returns the cache in the same 4-tuple form.
     """
     b, s, hq, d = q.shape
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    ck, cv = cache
+    quantized = len(cache) == 4
+    if quantized:
+        from ..serve.kv_cache import dequantize_kv, quantize_kv
+
+        ck, cv, cks, cvs = cache
+        qk_new, sk_new = quantize_kv(k_new)
+        qv_new, sv_new = quantize_kv(v_new)
+    else:
+        ck, cv = cache
+        cks = cvs = None
     from .flash_attention import resolve_use_flash
 
     if s != 1:
@@ -331,32 +350,69 @@ def slot_cached_attention(
         if page_tables is not None:
             ps = ck.shape[1]
             pp = page_tables.shape[1]
-            ck = paged_scatter_tokens(ck, k_new, page_tables, positions, ps)
-            cv = paged_scatter_tokens(cv, v_new, page_tables, positions, ps)
+            if quantized:
+                ck = paged_scatter_tokens(
+                    ck, qk_new, page_tables, positions, ps
+                )
+                cv = paged_scatter_tokens(
+                    cv, qv_new, page_tables, positions, ps
+                )
+                cks = paged_scatter_tokens(
+                    cks, sk_new, page_tables, positions, ps
+                )
+                cvs = paged_scatter_tokens(
+                    cvs, sv_new, page_tables, positions, ps
+                )
+            else:
+                ck = paged_scatter_tokens(
+                    ck, k_new, page_tables, positions, ps
+                )
+                cv = paged_scatter_tokens(
+                    cv, v_new, page_tables, positions, ps
+                )
+            new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
             if ps >= 8 and resolve_use_flash(use_flash):
                 from .decode_attention import paged_decode_attention_block
 
                 out = paged_decode_attention_block(
-                    q, ck, cv, page_tables, positions, scale=scale
+                    q, ck, cv, page_tables, positions, scale=scale,
+                    k_scale=cks, v_scale=cvs,
                 )
-                return out, (ck, cv)
+                return out, new_cache
             flat = lambda c: c.reshape(-1, *c.shape[2:])  # noqa: E731
             view_rows = (
                 page_tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]
             ).reshape(b, pp * ps)
-            out = _slot_attend_block(
-                q, flat(ck)[view_rows], flat(cv)[view_rows], positions, scale
-            )
-            return out, (ck, cv)
-        ck = scatter_slot_tokens(ck, k_new, positions)
-        cv = scatter_slot_tokens(cv, v_new, positions)
+            vk, vv = flat(ck)[view_rows], flat(cv)[view_rows]
+            if quantized:
+                vk = dequantize_kv(vk, flat(cks)[view_rows])
+                vv = dequantize_kv(vv, flat(cvs)[view_rows])
+            out = _slot_attend_block(q, vk, vv, positions, scale)
+            return out, new_cache
+        if quantized:
+            ck = scatter_slot_tokens(ck, qk_new, positions)
+            cv = scatter_slot_tokens(cv, qv_new, positions)
+            cks = scatter_slot_tokens(cks, sk_new, positions)
+            cvs = scatter_slot_tokens(cvs, sv_new, positions)
+        else:
+            ck = scatter_slot_tokens(ck, k_new, positions)
+            cv = scatter_slot_tokens(cv, v_new, positions)
+        new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
         if resolve_use_flash(use_flash):
             from .decode_attention import decode_attention_block
 
-            out = decode_attention_block(q, ck, cv, positions, scale=scale)
-            return out, (ck, cv)
-        out = _slot_attend_block(q, ck, cv, positions, scale)
-        return out, (ck, cv)
+            out = decode_attention_block(
+                q, ck, cv, positions, scale=scale, k_scale=cks, v_scale=cvs
+            )
+            return out, new_cache
+        if quantized:
+            out = _slot_attend_block(
+                q, dequantize_kv(ck, cks), dequantize_kv(cv, cvs),
+                positions, scale,
+            )
+        else:
+            out = _slot_attend_block(q, ck, cv, positions, scale)
+        return out, new_cache
     if page_tables is not None:
         ps = ck.shape[1]
         pp = page_tables.shape[1]
@@ -370,37 +426,64 @@ def slot_cached_attention(
             page_tables[jnp.arange(b), positions // ps] * ps
             + positions % ps
         )
-        fk = flat(ck).at[rows].set(k_new[:, 0].astype(ck.dtype))
-        fv = flat(cv).at[rows].set(v_new[:, 0].astype(cv.dtype))
+        fk = flat(ck).at[rows].set(
+            (qk_new if quantized else k_new)[:, 0].astype(ck.dtype)
+        )
+        fv = flat(cv).at[rows].set(
+            (qv_new if quantized else v_new)[:, 0].astype(cv.dtype)
+        )
         ck, cv = fk.reshape(ck.shape), fv.reshape(cv.shape)
+        if quantized:
+            fks = flat(cks).at[rows].set(sk_new[:, 0])
+            fvs = flat(cvs).at[rows].set(sv_new[:, 0])
+            cks, cvs = fks.reshape(cks.shape), fvs.reshape(cvs.shape)
+        new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
         # the paged kernel needs >= sublane-height pages on real TPUs;
         # tiny pages stay on the gather path
         if window is None and ps >= 8 and resolve_use_flash(use_flash):
             from .decode_attention import paged_decode_attention
 
             out = paged_decode_attention(
-                q, ck, cv, page_tables, positions, scale=scale
+                q, ck, cv, page_tables, positions, scale=scale,
+                k_scale=cks, v_scale=cvs,
             )
-            return out, (ck, cv)
+            return out, new_cache
         view_rows = (
             page_tables[:, :, None] * ps + jnp.arange(ps)[None, None, :]
         ).reshape(b, pp * ps)
-        out = _slot_attend(
-            q, fk[view_rows], fv[view_rows], positions, scale, window
-        )
-        return out, (ck, cv)
+        vk, vv = fk[view_rows], fv[view_rows]
+        if quantized:
+            vk = dequantize_kv(vk, fks[view_rows])
+            vv = dequantize_kv(vv, fvs[view_rows])
+        out = _slot_attend(q, vk, vv, positions, scale, window)
+        return out, new_cache
     write = lambda c, x, p: lax.dynamic_update_slice(  # noqa: E731
         c, x.astype(c.dtype), (p, 0, 0)
     )
-    ck = jax.vmap(write)(ck, k_new, positions)
-    cv = jax.vmap(write)(cv, v_new, positions)
+    if quantized:
+        ck = jax.vmap(write)(ck, qk_new, positions)
+        cv = jax.vmap(write)(cv, qv_new, positions)
+        cks = jax.vmap(write)(cks, sk_new, positions)
+        cvs = jax.vmap(write)(cvs, sv_new, positions)
+    else:
+        ck = jax.vmap(write)(ck, k_new, positions)
+        cv = jax.vmap(write)(cv, v_new, positions)
+    new_cache = (ck, cv, cks, cvs) if quantized else (ck, cv)
     if window is None and resolve_use_flash(use_flash):
         from .decode_attention import decode_attention
 
-        out = decode_attention(q, ck, cv, positions, scale=scale)
-        return out, (ck, cv)
-    out = _slot_attend(q, ck, cv, positions, scale, window)
-    return out, (ck, cv)
+        out = decode_attention(
+            q, ck, cv, positions, scale=scale, k_scale=cks, v_scale=cvs
+        )
+        return out, new_cache
+    if quantized:
+        out = _slot_attend(
+            q, dequantize_kv(ck, cks), dequantize_kv(cv, cvs),
+            positions, scale, window,
+        )
+    else:
+        out = _slot_attend(q, ck, cv, positions, scale, window)
+    return out, new_cache
 
 
 def multihead_attention(

@@ -78,26 +78,41 @@ _MIN_ROWS = 8  # f32 sublane minimum: GQA group rows pad up to this
 
 def _decode_kernel(
     pos_ref,  # scalar prefetch: (B,) int32 per-slot visible depth
-    q_ref,  # (rows, D) this slot's query heads for one KV group
-    k_ref,  # (block_k, D)
-    v_ref,  # (block_k, D)
-    o_ref,  # (rows, D)
-    acc_ref,  # VMEM scratch (rows, D) f32
-    m_ref,  # VMEM scratch (rows, 1) f32
-    l_ref,  # VMEM scratch (rows, 1) f32
-    *,
+    *refs,  # q (rows, D), k/v (block_k, D) [, k/v scales (block_k, 1)],
+    #         o (rows, D), then VMEM scratch acc (rows, D), m/l (rows, 1)
     scale: float,
     block_k: int,
     n_k: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref = refs[:5]
+        o_ref, acc_ref, m_ref, l_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     kk = pl.program_id(2)
     pos = pos_ref[b]
 
+    def vblock():
+        """This K block's V rows, dequantized in VMEM when int8."""
+        v = v_ref[...].astype(jnp.float32)
+        if vs_ref is not None:
+            v = v * vs_ref[...]
+        return v
+
     def tile(mask_value):
-        """Masked (rows, block_k) f32 logits for this K block."""
+        """Masked (rows, block_k) f32 logits for this K block.
+
+        int8 K dequantizes HERE — elementwise ``int8 -> f32 * scale`` on
+        the block already resident in VMEM, the exact ops the jnp
+        reference's ``dequantize_kv`` applies, so quantized kernel-vs-jnp
+        parity inherits the unquantized bounds."""
         q = q_ref[...].astype(jnp.float32)
         k = k_ref[...].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[...]
         logits = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
@@ -120,7 +135,7 @@ def _decode_kernel(
         unnorm = jnp.exp(logits - m)
         probs = unnorm / jnp.sum(unnorm, axis=-1, keepdims=True)
         o_ref[...] = jax.lax.dot_general(
-            probs, v_ref[...].astype(jnp.float32),
+            probs, vblock(),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(o_ref.dtype)
@@ -145,7 +160,7 @@ def _decode_kernel(
             p, axis=-1, keepdims=True
         )
         acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
-            p, v_ref[...].astype(jnp.float32),
+            p, vblock(),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -160,6 +175,22 @@ def _decode_kernel(
         ).astype(o_ref.dtype)
 
 
+def _check_kv_scales(k_scale, v_scale, ck):
+    """Validate the optional int8-dequant scale operands (shared by all
+    four kernel wrappers).  Returns the ``quantized`` flag."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    if k_scale is None:
+        return False
+    want = ck.shape[:3] + (1,)
+    if k_scale.shape != want or v_scale.shape != want:
+        raise ValueError(
+            f"kv scale shapes {k_scale.shape}/{v_scale.shape} != "
+            f"cache rows + trailing 1 {want}"
+        )
+    return True
+
+
 def decode_attention(
     q: jax.Array,
     ck: jax.Array,
@@ -169,6 +200,8 @@ def decode_attention(
     scale: Optional[float] = None,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Slot-paged single-token decode attention (post-write).
 
@@ -183,6 +216,14 @@ def decode_attention(
     when one block covers ``max_len`` the interpret-mode result is
     bit-identical to the jnp reference (module docstring).  ``interpret``
     defaults to True off-TPU, per the repo kernel convention.
+
+    **int8 cache** (``kv_dtype="int8"``): pass the f32 per-row per-head
+    scales as ``k_scale``/``v_scale`` of shape (B, max_len, Hkv, 1) —
+    they ride the SAME index map as their data (one (block_k, 1) scale
+    block per K/V block, clamped together), and the kernel dequantizes
+    each block in VMEM before Q·K / P·V, which stay f32.  HBM traffic
+    per step is the int8 block plus a 1/D-sized scale column — the
+    halved-bytes contract the cost cards price.
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -190,6 +231,7 @@ def decode_attention(
     max_len, hkv = ck.shape[1], ck.shape[2]
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    quantized = _check_kv_scales(k_scale, v_scale, ck)
     n_rep = hq // hkv
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     block_k = _shrink_block(block_k, max_len)
@@ -210,16 +252,24 @@ def decode_attention(
         # so pruned grid steps move no bytes
         return (bb, jnp.minimum(kk, pos_ref[bb] // block_k), h, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+        ),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+    ]
+    operands = [qg, ck, cv]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((None, block_k, None, 1), kv_index),
+            pl.BlockSpec((None, block_k, None, 1), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, n_k),
-        in_specs=[
-            pl.BlockSpec(
-                (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
-            ),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
         ),
@@ -231,7 +281,8 @@ def decode_attention(
     )
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale=scale_, block_k=block_k, n_k=n_k
+            _decode_kernel, scale=scale_, block_k=block_k, n_k=n_k,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
@@ -239,25 +290,20 @@ def decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(positions, qg, ck, cv)
+    )(positions, *operands)
     return out[:, :, :n_rep, :].reshape(b, 1, hq, d)
 
 
 def _decode_block_kernel(
     pos_ref,  # scalar prefetch: (B,) int32 per-slot BASE depth
-    q_ref,  # (rows, D): S query tokens x n_rep GQA heads, row-major
-    k_ref,  # (block_k, D)
-    v_ref,  # (block_k, D)
-    o_ref,  # (rows, D)
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *refs,  # q (rows, D), k/v (block_k, D) [, k/v scales (block_k, 1)],
+    #         o (rows, D), then VMEM scratch
     scale: float,
     block_k: int,
     n_k: int,
     s: int,
     n_rep: int,
+    quantized: bool = False,
 ):
     """Speculative-verify sibling of ``_decode_kernel``: S > 1 candidate
     tokens per slot ride as EXTRA MATMUL ROWS — row ``r`` is query token
@@ -265,14 +311,29 @@ def _decode_block_kernel(
     ``pos + r // n_rep``.  Same single-block exact-op-order fast path and
     multi-block online-softmax merge as the one-token kernel; the only
     new math is the per-row depth offset in the visibility mask (the
-    kernel analogue of ``_slot_attend_block``'s shifted mask)."""
+    kernel analogue of ``_slot_attend_block``'s shifted mask).  int8
+    dequant is per K/V block in VMEM, as in ``_decode_kernel``."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref = refs[:5]
+        o_ref, acc_ref, m_ref, l_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     kk = pl.program_id(2)
     pos = pos_ref[b]
 
+    def vblock():
+        v = v_ref[...].astype(jnp.float32)
+        if vs_ref is not None:
+            v = v * vs_ref[...]
+        return v
+
     def tile(mask_value):
         q = q_ref[...].astype(jnp.float32)
         k = k_ref[...].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[...]
         logits = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
@@ -295,7 +356,7 @@ def _decode_block_kernel(
         unnorm = jnp.exp(logits - m)
         probs = unnorm / jnp.sum(unnorm, axis=-1, keepdims=True)
         o_ref[...] = jax.lax.dot_general(
-            probs, v_ref[...].astype(jnp.float32),
+            probs, vblock(),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(o_ref.dtype)
@@ -319,7 +380,7 @@ def _decode_block_kernel(
             p, axis=-1, keepdims=True
         )
         acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
-            p, v_ref[...].astype(jnp.float32),
+            p, vblock(),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -365,6 +426,8 @@ def decode_attention_block(
     scale: Optional[float] = None,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Slot-paged MULTI-token decode attention (post-write): the
     speculative verify block.  ``q``: (B, S, Hq, D) — ``S = K + 1``
@@ -379,12 +442,14 @@ def decode_attention_block(
     speculation.  The DMA clamp and block pruning use the block's
     deepest row ``positions[b] + S - 1``.  The one-token kernel
     (:func:`decode_attention`) is untouched; its S == 1 exactness
-    contract is pinned separately.
+    contract is pinned separately.  ``k_scale``/``v_scale``: int8-cache
+    dequant scales, exactly as in :func:`decode_attention`.
     """
     b, s, hq, d = q.shape
     max_len, hkv = ck.shape[1], ck.shape[2]
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    quantized = _check_kv_scales(k_scale, v_scale, ck)
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     block_k = _shrink_block(block_k, max_len)
     n_k = max_len // block_k
@@ -398,16 +463,24 @@ def decode_attention_block(
         last = jnp.minimum(pos_ref[bb] + (s - 1), max_len - 1) // block_k
         return (bb, jnp.minimum(kk, last), h, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
+        ),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+    ]
+    operands = [qg, ck, cv]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((None, block_k, None, 1), kv_index),
+            pl.BlockSpec((None, block_k, None, 1), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, n_k),
-        in_specs=[
-            pl.BlockSpec(
-                (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
-            ),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, None, rows, d), lambda bb, h, kk, pos_ref: (bb, h, 0, 0)
         ),
@@ -421,6 +494,7 @@ def decode_attention_block(
         functools.partial(
             _decode_block_kernel,
             scale=scale_, block_k=block_k, n_k=n_k, s=s, n_rep=n_rep,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
@@ -428,12 +502,12 @@ def decode_attention_block(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(positions, qg, ck, cv)
+    )(positions, *operands)
     return _block_unfold(out, b, s, hq, d, hkv, n_rep, real)
 
 
 def _paged_decode_block_kernel(
-    pos_ref, pt_ref, *refs, scale, block_k, n_k, s, n_rep
+    pos_ref, pt_ref, *refs, scale, block_k, n_k, s, n_rep, quantized=False
 ):
     """Paged twin of ``_decode_block_kernel`` — as with the one-token
     pair, the page table lives entirely in the K/V index maps and the
@@ -441,7 +515,7 @@ def _paged_decode_block_kernel(
     del pt_ref
     _decode_block_kernel(
         pos_ref, *refs, scale=scale, block_k=block_k, n_k=n_k, s=s,
-        n_rep=n_rep,
+        n_rep=n_rep, quantized=quantized,
     )
 
 
@@ -454,12 +528,16 @@ def paged_decode_attention_block(
     *,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Paged multi-token decode attention: :func:`decode_attention_block`
     over the page pools, gathered page-by-page through the
     scalar-prefetched table exactly like :func:`paged_decode_attention`
     (block == page; pruning and the DMA clamp run in TABLE space on the
-    block's deepest row ``positions[b] + S - 1``)."""
+    block's deepest row ``positions[b] + S - 1``).  ``k_scale``/
+    ``v_scale``: int8-cache dequant scales of shape (num_pages,
+    page_size, Hkv, 1), gathered through the same table."""
     b, s, hq, d = q.shape
     ps, hkv = ck.shape[1], ck.shape[2]
     if hq % hkv != 0:
@@ -468,6 +546,7 @@ def paged_decode_attention_block(
         raise ValueError(
             f"page_tables rows {page_tables.shape[0]} != batch {b}"
         )
+    quantized = _check_kv_scales(k_scale, v_scale, ck)
     pp = page_tables.shape[1]
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     if interpret is None:
@@ -482,17 +561,25 @@ def paged_decode_attention_block(
         page = pt_ref[bb * pp + jnp.minimum(kk, last)]
         return (page, 0, h, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, rows, d),
+            lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+        ),
+        pl.BlockSpec((None, ps, None, d), kv_index),
+        pl.BlockSpec((None, ps, None, d), kv_index),
+    ]
+    operands = [qg, ck, cv]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((None, ps, None, 1), kv_index),
+            pl.BlockSpec((None, ps, None, 1), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, pp),
-        in_specs=[
-            pl.BlockSpec(
-                (None, None, rows, d),
-                lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
-            ),
-            pl.BlockSpec((None, ps, None, d), kv_index),
-            pl.BlockSpec((None, ps, None, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, None, rows, d),
             lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
@@ -507,6 +594,7 @@ def paged_decode_attention_block(
         functools.partial(
             _paged_decode_block_kernel,
             scale=scale_, block_k=ps, n_k=pp, s=s, n_rep=n_rep,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
@@ -514,18 +602,23 @@ def paged_decode_attention_block(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(positions, pt_flat, qg, ck, cv)
+    )(positions, pt_flat, *operands)
     return _block_unfold(out, b, s, hq, d, hkv, n_rep, real)
 
 
-def _paged_decode_kernel(pos_ref, pt_ref, *refs, scale, block_k, n_k):
+def _paged_decode_kernel(
+    pos_ref, pt_ref, *refs, scale, block_k, n_k, quantized=False
+):
     """The paged grid's kernel body IS the slot kernel's: the page table
     is consumed entirely by the K/V index maps (which block to DMA); the
     in-block math — masking against ``pos``, online softmax, GQA rows —
     is position-indexed exactly as in the contiguous layout, so the two
     kernels cannot diverge."""
     del pt_ref
-    _decode_kernel(pos_ref, *refs, scale=scale, block_k=block_k, n_k=n_k)
+    _decode_kernel(
+        pos_ref, *refs, scale=scale, block_k=block_k, n_k=n_k,
+        quantized=quantized,
+    )
 
 
 def paged_decode_attention(
@@ -537,6 +630,8 @@ def paged_decode_attention(
     *,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Paged single-token decode attention (post-write): the serving
     engine's prefix-sharing sibling of :func:`decode_attention`.
@@ -559,7 +654,9 @@ def paged_decode_attention(
     (``pages_per_slot == 1``) the kernel takes the same
     bit-exact-softmax fast path the slot kernel pins; multi-page rows
     take the online-softmax merge at the same <= 2-ulp association bar
-    (tests/test_decode_attention.py).
+    (tests/test_decode_attention.py).  ``k_scale``/``v_scale``:
+    int8-cache dequant scales of shape (num_pages, page_size, Hkv, 1),
+    gathered through the same table as their pages.
     """
     b, s, hq, d = q.shape
     if s != 1:
@@ -573,6 +670,7 @@ def paged_decode_attention(
         raise ValueError(
             f"page_tables rows {page_tables.shape[0]} != batch {b}"
         )
+    quantized = _check_kv_scales(k_scale, v_scale, ck)
     pp = page_tables.shape[1]
     n_rep = hq // hkv
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -594,17 +692,25 @@ def paged_decode_attention(
         page = pt_ref[bb * pp + jnp.minimum(kk, pos_ref[bb] // ps)]
         return (page, 0, h, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, rows, d),
+            lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
+        ),
+        pl.BlockSpec((None, ps, None, d), kv_index),
+        pl.BlockSpec((None, ps, None, d), kv_index),
+    ]
+    operands = [qg, ck, cv]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((None, ps, None, 1), kv_index),
+            pl.BlockSpec((None, ps, None, 1), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, pp),
-        in_specs=[
-            pl.BlockSpec(
-                (None, None, rows, d),
-                lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
-            ),
-            pl.BlockSpec((None, ps, None, d), kv_index),
-            pl.BlockSpec((None, ps, None, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, None, rows, d),
             lambda bb, h, kk, pos_ref, pt_ref: (bb, h, 0, 0),
@@ -617,7 +723,8 @@ def paged_decode_attention(
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_decode_kernel, scale=scale_, block_k=ps, n_k=pp
+            _paged_decode_kernel, scale=scale_, block_k=ps, n_k=pp,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
@@ -625,5 +732,5 @@ def paged_decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(positions, pt_flat, qg, ck, cv)
+    )(positions, pt_flat, *operands)
     return out[:, :, :n_rep, :].reshape(b, 1, hq, d)

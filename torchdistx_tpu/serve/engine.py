@@ -109,6 +109,8 @@ from ..utils.profiling import timed_annotation
 from .kv_cache import (
     PagedKVCache,
     SlotKVCache,
+    canonicalize_kv_dtype,
+    dequantize_kv,
     paged_scatter_rows,
     paged_view,
     write_slot,
@@ -357,6 +359,7 @@ class ServeEngine:
         persistent_stream: bool = False,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
         prefix_cache: bool = True,
         params: Optional[dict] = None,
         finished_history: int = 1024,
@@ -518,6 +521,12 @@ class ServeEngine:
             if self._kv_sharding is None
             else NamedSharding(self._kv_sharding.mesh, PartitionSpec())
         )
+        # int8 KV quantization (kv_dtype="int8"): the caches store
+        # per-layer (k, v, k_scale, v_scale) 4-tuples and every program
+        # quantizes on write / dequantizes on read (serve/kv_cache.py);
+        # "bfloat16"/"float16"/"float32" are plain cast caches (A/B
+        # baselines); None keeps the model's own cache dtype
+        self.kv_dtype = canonicalize_kv_dtype(kv_dtype)
         self.page_size = None if page_size is None else int(page_size)
         self.paged = self.page_size is not None
         if self.paged:
@@ -538,6 +547,7 @@ class ServeEngine:
                 self.page_size,
                 self.num_pages,
                 placement=_placement,
+                kv_dtype=self.kv_dtype,
             )
         else:
             if num_pages is not None:
@@ -550,13 +560,27 @@ class ServeEngine:
                 self.num_slots,
                 self.max_len,
                 placement=_placement,
+                kv_dtype=self.kv_dtype,
             )
+        self.kv_quantized = self.cache.quantized
+        # the dtype actually stored (model default resolved), for the
+        # attributable refusal/plan naming satellite
+        self.kv_dtype_name = str(self.cache.kv[0][0].dtype)
         self.scheduler = Scheduler(self.num_slots, max_tokens_in_flight)
+        # per-token KV footprint across all layers, scales included —
+        # the quantization win the gauges make visible
+        _kv_rows = (
+            self.num_pages * self.page_size
+            if self.paged
+            else self.num_slots * self.max_len
+        )
         self.metrics = ServeMetrics(
             self.num_slots,
             num_pages=self.num_pages,
             ring_capacity=self.ring_capacity,
             speculate=self.speculate or None,
+            kv_cache_bytes=self.cache.nbytes,
+            kv_bytes_per_token=self.cache.nbytes // _kv_rows,
         )
         self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
         # persistent mode: prefill defers its first-token fetch — the
@@ -672,7 +696,8 @@ class ServeEngine:
                 raise ValueError(
                     f"prompt ({prompt.size}) + max_new_tokens "
                     f"({max_new_tokens}) needs {need} pages of "
-                    f"{self.page_size} tokens, but the pool holds only "
+                    f"{self.page_size} tokens, but the "
+                    f"{self.kv_dtype_name} cache pool holds only "
                     f"{self.pool.capacity} allocatable pages — raise "
                     "num_pages or shrink the request"
                 )
@@ -866,6 +891,15 @@ class ServeEngine:
                 f"page-size mismatch: source {self.page_size} != "
                 f"target {target.page_size}"
             )
+        if self.kv_dtype_name != target.kv_dtype_name:
+            # a requantization pass could bridge this, but silently
+            # changing a stream's cache precision mid-flight would break
+            # the bit-stability contract the move advertises
+            raise RuntimeError(
+                f"KV dtype mismatch: source {self.kv_dtype_name} cache "
+                f"!= target {target.kv_dtype_name} — KV moves never "
+                "requantize"
+            )
         free_b = target.scheduler.free_slot_count
         if len(running) > free_b:
             raise RuntimeError(
@@ -1017,6 +1051,15 @@ class ServeEngine:
                 f"page-size mismatch: source {self.page_size} != "
                 f"target {target.page_size}"
             )
+        if self.kv_dtype_name != target.kv_dtype_name:
+            # a requantization pass could bridge this, but silently
+            # changing a stream's cache precision mid-flight would break
+            # the bit-stability contract the move advertises
+            raise RuntimeError(
+                f"KV dtype mismatch: source {self.kv_dtype_name} cache "
+                f"!= target {target.kv_dtype_name} — KV moves never "
+                "requantize"
+            )
         if target.scheduler.free_slot_count < 1:
             raise RuntimeError(
                 f"handoff target has no free slot for request {req.rid}"
@@ -1085,14 +1128,18 @@ class ServeEngine:
 
     def _copy_kv_slot(self, target, s_a: int, s_b: int):
         """Move slab slot ``s_a``'s KV rows into ``target`` slot ``s_b``,
-        booking the tp redistribution per layer/array.  Returns
+        booking the tp redistribution per layer/array.  Iterates each
+        layer's FULL entry tuple — ``(k, v)`` or the quantized
+        ``(k, v, k_scale, v_scale)`` — so int8 data and its scale rows
+        move (and price) together; each array's wire unit comes from its
+        own dtype, giving the closed form its dtype factor.  Returns
         (wire_bytes, collectives)."""
         wire = 0
         n_coll = 0
         new_kv = []
-        for (ka, va), (kb, vb) in zip(self.cache.kv, target.cache.kv):
+        for entry_a, entry_b in zip(self.cache.kv, target.cache.kv):
             pair = []
-            for src, dst in ((ka, kb), (va, vb)):
+            for src, dst in zip(entry_a, entry_b):
                 g = self._kv_migration_group(src, dst)
                 unit = int(np.prod(src.shape[1:])) * np.dtype(
                     src.dtype
@@ -1119,17 +1166,18 @@ class ServeEngine:
 
     def _copy_kv_pages(self, target, pages_a: List[int], pages_b: List[int]):
         """Move a page chain between paged pools (one gather/scatter per
-        layer/array over the whole chain).  Returns (wire_bytes,
-        collectives)."""
+        layer/array over the whole chain — scale arrays included for
+        quantized pools, per-array dtype pricing as in
+        :meth:`_copy_kv_slot`).  Returns (wire_bytes, collectives)."""
         idx_a = jnp.asarray(pages_a, jnp.int32)
         idx_b = jnp.asarray(pages_b, jnp.int32)
         n = len(pages_a)
         wire = 0
         n_coll = 0
         new_kv = []
-        for (ka, va), (kb, vb) in zip(self.cache.kv, target.cache.kv):
+        for entry_a, entry_b in zip(self.cache.kv, target.cache.kv):
             pair = []
-            for src, dst in ((ka, kb), (va, vb)):
+            for src, dst in zip(entry_a, entry_b):
                 g = self._kv_migration_group(src, dst)
                 unit = int(np.prod(src.shape[1:])) * np.dtype(
                     src.dtype
@@ -1212,11 +1260,18 @@ class ServeEngine:
         correct way to reset between bench passes; hand-constructing the
         object would silently drop the paged/persistent/speculative
         gauge families."""
+        _kv_rows = (
+            self.num_pages * self.page_size
+            if self.paged
+            else self.num_slots * self.max_len
+        )
         self.metrics = ServeMetrics(
             self.num_slots,
             num_pages=self.num_pages,
             ring_capacity=self.ring_capacity,
             speculate=self.speculate or None,
+            kv_cache_bytes=self.cache.nbytes,
+            kv_bytes_per_token=self.cache.nbytes // _kv_rows,
         )
         return self.metrics
 
@@ -1280,9 +1335,12 @@ class ServeEngine:
                 self.tp_axis,
                 tuple(d.id for d in self.mesh.devices.flat),
             )
+        # kv_dtype keys the cache REPRESENTATION: an int8 engine's
+        # programs carry 4-tuple carries + dequant ops and must never
+        # share (or co-count) with a plain engine's on the same model
         return (
             self.num_slots, self.max_len, self.top_k, self.top_p,
-            self.page_size, mesh_key,
+            self.page_size, self.kv_dtype, mesh_key,
         )
 
     def _out_shardings(self, n_scalar: int):
@@ -1343,16 +1401,23 @@ class ServeEngine:
         model, sampler, max_len = self.model, self._sampler, self.max_len
 
         def build(params, kv, tokens, cache_pos, true_len, slot, temp, seed):
+            def row(c):
+                return jax.lax.dynamic_slice(
+                    c, (slot, 0, 0, 0), (1, max_len) + c.shape[2:]
+                )
+
+            # quantized caches: slice data + scale rows, hand the model a
+            # dequantized pair view; write_slot requantizes on the way
+            # back (bit-stable for untouched rows — power-of-two scales,
+            # serve/kv_cache.py)
             view = [
                 (
-                    jax.lax.dynamic_slice(
-                        ck, (slot, 0, 0, 0), (1, max_len) + ck.shape[2:]
-                    ),
-                    jax.lax.dynamic_slice(
-                        cv, (slot, 0, 0, 0), (1, max_len) + cv.shape[2:]
-                    ),
+                    (dequantize_kv(row(e[0]), row(e[2])),
+                     dequantize_kv(row(e[1]), row(e[3])))
+                    if len(e) == 4
+                    else (row(e[0]), row(e[1]))
                 )
-                for ck, cv in kv
+                for e in kv
             ]
             logits, view = functional_call(
                 model, params, (tokens, view, cache_pos),
@@ -1658,17 +1723,34 @@ class ServeEngine:
             # models bigger than one chip's HBM
             self._static_footprint = {
                 "weights": obs_memory.tree_device_bytes(self.params),
-                "kv_cache": obs_memory.tree_device_bytes(self.cache.kv),
+                "kv_cache": obs_memory.tree_device_bytes(
+                    [e[:2] for e in self.cache.kv]
+                ),
             }
+            if self.kv_quantized:
+                # int8 engines split the pool: "kv_cache" is the int8
+                # data alone (the component that halves exactly vs a
+                # bf16 cache — the bench A/B's strict pin) and the f32
+                # scale sidecar is priced separately
+                self._static_footprint["kv_scales"] = (
+                    obs_memory.tree_device_bytes(
+                        [e[2:] for e in self.cache.kv]
+                    )
+                )
         components = dict(self._static_footprint)
         temp = self.cost_book.max_temp_bytes()
         if temp:
             components["program_temp"] = temp
         if budget_bytes is None:
             budget_bytes = self.hbm_budget
-        return obs_memory.capacity_plan(
+        plan = obs_memory.capacity_plan(
             components, budget_bytes=budget_bytes
         )
+        # name the cache dtype on the plan itself (components stay
+        # numeric — capacity_plan drops non-numeric values), so an
+        # over-budget refusal under mixed-dtype fleets is attributable
+        plan["kv_cache_dtype"] = self.kv_dtype_name
+        return plan
 
     # -- cost observatory / stall watchdog --------------------------------
 

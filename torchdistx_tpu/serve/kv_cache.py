@@ -85,21 +85,135 @@ __all__ = [
     "paged_scatter_rows",
     "scatter_slot_tokens",
     "paged_scatter_tokens",
+    "quantize_kv",
+    "dequantize_kv",
+    "quantize_cache",
+    "dequantize_cache",
+    "canonicalize_kv_dtype",
 ]
+
+# -- int8 KV quantization ---------------------------------------------------
+#
+# ``kv_dtype="int8"`` stores each layer as a 4-tuple ``(k, v, k_scale,
+# v_scale)`` instead of the ``(k, v)`` pair: int8 data plus f32
+# per-token-row per-head scales of shape ``(lead, rows, Hkv, 1)``.  The
+# scales are DEVICE arrays riding through the same scatter/gather sites
+# as the data (they share its leading dims, so every flat-row index
+# computed for a K/V write addresses the matching scale row) — host-side
+# scales could not ride through the donated jitted programs.
+#
+# Scales are constrained to POWERS OF TWO (``s = 2^ceil(log2(amax/127))``
+# via frexp/ldexp).  That makes ``dequantize(quantize(x))`` exactly
+# idempotent at the value level: requantizing a dequantized row yields
+# ``s' = s * 2^c``, ``q' = q * 2^-c`` with both steps exact in f32, so
+# ``q' * s' == q * s`` bit for bit.  The warm-prefill program and the
+# paged prefill both round-trip untouched prefix rows through
+# dequantize → forward → requantize, and this property is what keeps
+# those rows bit-stable across the trip (the same contract the f32
+# cache gets for free).
+
+_KV_DTYPES = {
+    "int8": jnp.int8,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+}
+
+
+def canonicalize_kv_dtype(kv_dtype: Any) -> Optional[str]:
+    """``None`` → model-default cache dtype; otherwise a canonical dtype
+    name from the supported set (``int8`` quantized; ``bfloat16`` /
+    ``float16`` / ``float32`` plain casts, e.g. a bf16 A/B baseline for
+    an f32 model)."""
+    if kv_dtype is None:
+        return None
+    name = str(np.dtype(kv_dtype).name) if not isinstance(
+        kv_dtype, str
+    ) else kv_dtype
+    if name not in _KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {sorted(_KV_DTYPES)} or None "
+            f"(model default), got {kv_dtype!r}"
+        )
+    return name
+
+
+def quantize_kv(x: jax.Array):
+    """Quantize K or V rows to ``(int8 data, f32 power-of-two scales)``.
+
+    ``x``: (..., H, D).  Returns ``q`` of ``x.shape`` int8 and ``scale``
+    of ``x.shape[:-1] + (1,)`` f32 with ``scale = 2^ceil(log2(amax/127))``
+    per (row, head) — the smallest power of two whose 127-step grid
+    covers the row (all-zero rows get a harmless 0.5).  Values quantize
+    as ``round(x / scale)`` clipped to [-127, 127]; dequantization is
+    ``q * scale`` (exact: int8 times power of two)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    m, e = jnp.frexp(amax / jnp.float32(127.0))
+    # frexp: v = m * 2^e, m in [0.5, 1) — ceil(log2 v) is e except at
+    # exact powers of two (m == 0.5), where it is e - 1
+    scale = jnp.ldexp(
+        jnp.ones_like(m), e - (m <= jnp.float32(0.5)).astype(e.dtype)
+    )
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Exact inverse read of :func:`quantize_kv`: f32 ``q * scale``."""
+    return q.astype(jnp.float32) * scale
+
+
+def quantize_cache(kv: Any) -> Any:
+    """Pairs → per-layer ``(k, v, k_scale, v_scale)`` 4-tuples."""
+    out: List[tuple] = []
+    for k, v in kv:
+        qk, sk = quantize_kv(k)
+        qv, sv = quantize_kv(v)
+        out.append((qk, qv, sk, sv))
+    return out
+
+
+def dequantize_cache(kv: Any) -> Any:
+    """4-tuples (or pass-through pairs) → f32 ``(k, v)`` pairs."""
+    out: List[tuple] = []
+    for entry in kv:
+        if len(entry) == 4:
+            k, v, sk, sv = entry
+            out.append((dequantize_kv(k, sk), dequantize_kv(v, sv)))
+        else:
+            out.append(entry)
+    return out
 
 
 def write_slot(kv: Any, slab: Any, slot) -> Any:
     """Write one request's prefilled cache slab into slot row ``slot``.
 
     ``kv``: the engine cache — list per layer of ``(k, v)`` with shape
-    (num_slots, max_len, H, D).  ``slab``: ``init_cache(1, bucket)``
-    output run through the model's prefill — list per layer of ``(k, v)``
-    with shape (1, bucket, H, D).  ``slot`` may be traced (it is, inside
-    the jitted prefill program); the write is a pure
-    ``dynamic_update_slice`` per layer — no recompile across slots.
+    (num_slots, max_len, H, D), or quantized 4-tuples ``(k, v, k_scale,
+    v_scale)`` (the slab pairs quantize on write).  ``slab``:
+    ``init_cache(1, bucket)`` output run through the model's prefill —
+    list per layer of ``(k, v)`` with shape (1, bucket, H, D).  ``slot``
+    may be traced (it is, inside the jitted prefill program); the write
+    is a pure ``dynamic_update_slice`` per layer — no recompile across
+    slots.
     """
     out: List[tuple] = []
-    for (ck, cv), (sk, sv) in zip(kv, slab):
+    for entry, (sk, sv) in zip(kv, slab):
+        if len(entry) == 4:
+            ck, cv, cks, cvs = entry
+            qk, ssk = quantize_kv(sk)
+            qv, ssv = quantize_kv(sv)
+            out.append(
+                (
+                    lax.dynamic_update_slice(ck, qk, (slot, 0, 0, 0)),
+                    lax.dynamic_update_slice(cv, qv, (slot, 0, 0, 0)),
+                    lax.dynamic_update_slice(cks, ssk, (slot, 0, 0, 0)),
+                    lax.dynamic_update_slice(cvs, ssv, (slot, 0, 0, 0)),
+                )
+            )
+            continue
+        ck, cv = entry
         out.append(
             (
                 lax.dynamic_update_slice(
@@ -122,16 +236,22 @@ def paged_view(kv: Any, table_row: jax.Array, page_size: int) -> Any:
     but sit beyond the visibility mask).  Returns the model-facing view:
     list per layer of ``(k, v)`` with shape (1, max_len, H, D), where
     ``max_len = pages_per_slot * page_size``.  A pure gather — the pools
-    are read, never copied page-to-page.
+    are read, never copied page-to-page.  Quantized 4-tuple pools
+    dequantize in the gather: the view is always model-dtype pairs.
     """
     rows = (
         table_row[:, None] * page_size + jnp.arange(page_size)[None, :]
     ).reshape(-1)
     out: List[tuple] = []
-    for k, v in kv:
-        fk = k.reshape(-1, *k.shape[2:])
-        fv = v.reshape(-1, *v.shape[2:])
-        out.append((fk[rows][None], fv[rows][None]))
+    for entry in kv:
+        k, v = entry[0], entry[1]
+        fk = k.reshape(-1, *k.shape[2:])[rows]
+        fv = v.reshape(-1, *v.shape[2:])[rows]
+        if len(entry) == 4:
+            ks, vs = entry[2], entry[3]
+            fk = dequantize_kv(fk, ks.reshape(-1, *ks.shape[2:])[rows])
+            fv = dequantize_kv(fv, vs.reshape(-1, *vs.shape[2:])[rows])
+        out.append((fk[None], fv[None]))
     return out
 
 
@@ -143,13 +263,33 @@ def paged_scatter_rows(
     the slot's table row.  Only the suffix span moves — shared prefix
     pages are never rewritten.  ``length`` is static (the prefill
     bucket); rows landing past the slot's allocated pages route to the
-    scratch page (bucket padding) and are never visible."""
+    scratch page (bucket padding) and are never visible.  Quantized
+    4-tuple pools quantize the suffix on write (the scale rows scatter
+    through the same flat-row indices as the data)."""
     offs = start + jnp.arange(length)
     rows = table_row[offs // page_size] * page_size + offs % page_size
     out: List[tuple] = []
-    for (k, v), (wk, wv) in zip(kv, view):
+    for entry, (wk, wv) in zip(kv, view):
+        k, v = entry[0], entry[1]
         seg_k = lax.dynamic_slice_in_dim(wk[0], start, length, axis=0)
         seg_v = lax.dynamic_slice_in_dim(wv[0], start, length, axis=0)
+        if len(entry) == 4:
+            ks, vs = entry[2], entry[3]
+            seg_k, seg_ks = quantize_kv(seg_k)
+            seg_v, seg_vs = quantize_kv(seg_v)
+            fks = ks.reshape(-1, *ks.shape[2:]).at[rows].set(seg_ks)
+            fvs = vs.reshape(-1, *vs.shape[2:]).at[rows].set(seg_vs)
+            fk = k.reshape(-1, *k.shape[2:]).at[rows].set(seg_k)
+            fv = v.reshape(-1, *v.shape[2:]).at[rows].set(seg_v)
+            out.append(
+                (
+                    fk.reshape(k.shape),
+                    fv.reshape(v.shape),
+                    fks.reshape(ks.shape),
+                    fvs.reshape(vs.shape),
+                )
+            )
+            continue
         fk = k.reshape(-1, *k.shape[2:]).at[rows].set(seg_k.astype(k.dtype))
         fv = v.reshape(-1, *v.shape[2:]).at[rows].set(seg_v.astype(v.dtype))
         out.append((fk.reshape(k.shape), fv.reshape(v.shape)))
@@ -295,6 +435,37 @@ class _HostBookkeeping:
             for a in pair
         )
 
+    @property
+    def kv_data_nbytes(self) -> int:
+        """Bytes of the K/V data arrays alone (scales excluded) — the
+        quantity that halves exactly under ``kv_dtype="int8"``."""
+        return sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for entry in self.kv
+            for a in entry[:2]
+        )
+
+    @property
+    def kv_scale_nbytes(self) -> int:
+        """Bytes of the f32 scale arrays (0 for unquantized caches)."""
+        return sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for entry in self.kv
+            for a in entry[2:]
+        )
+
+    def _apply_kv_dtype(self, base: Any, kv_dtype: Any) -> Any:
+        """Canonicalize + record ``kv_dtype`` and transform the freshly
+        initialized model-dtype pairs into the stored representation."""
+        self.kv_dtype = canonicalize_kv_dtype(kv_dtype)
+        self.quantized = self.kv_dtype == "int8"
+        if self.quantized:
+            return quantize_cache(base)
+        if self.kv_dtype is not None:
+            dt = _KV_DTYPES[self.kv_dtype]
+            return [(k.astype(dt), v.astype(dt)) for k, v in base]
+        return base
+
 
 class SlotKVCache(_HostBookkeeping):
     """Host bookkeeping around the contiguous per-slot device cache."""
@@ -305,6 +476,7 @@ class SlotKVCache(_HostBookkeeping):
         num_slots: int,
         max_len: int,
         placement: Optional[Any] = None,
+        kv_dtype: Optional[str] = None,
     ):
         self._init_host(num_slots, max_len)
         # COMMIT the fresh cache to its placement: the engine's programs
@@ -318,9 +490,14 @@ class SlotKVCache(_HostBookkeeping):
         # Under ServeEngine(mesh=) the placement is a NamedSharding that
         # shards the Hkv axis over tp — each device commits only its
         # Hkv/tp head slice; everything host-side here (lengths, active,
-        # page tables) is per-slot metadata and never sharded.
+        # page tables) is per-slot metadata and never sharded.  The f32
+        # scale arrays of a quantized cache share the data's leading
+        # dims with a trailing 1, so the same NamedSharding prefix
+        # commits them alongside their head slice.
         self.kv = jax.device_put(
-            model.init_cache(self.num_slots, self.max_len),
+            self._apply_kv_dtype(
+                model.init_cache(self.num_slots, self.max_len), kv_dtype
+            ),
             placement if placement is not None else jax.devices()[0],
         )
 
@@ -345,6 +522,7 @@ class PagedKVCache(_HostBookkeeping):
         page_size: int,
         num_pages: int,
         placement: Optional[Any] = None,
+        kv_dtype: Optional[str] = None,
     ):
         self._init_host(num_slots, max_len)
         if page_size < 1:
@@ -364,7 +542,9 @@ class PagedKVCache(_HostBookkeeping):
         self.pages_per_slot = self.max_len // self.page_size
         # same commit-at-construction rationale as SlotKVCache
         self.kv = jax.device_put(
-            model.init_cache(self.num_pages, self.page_size),
+            self._apply_kv_dtype(
+                model.init_cache(self.num_pages, self.page_size), kv_dtype
+            ),
             placement if placement is not None else jax.devices()[0],
         )
         self.page_tables = np.full(

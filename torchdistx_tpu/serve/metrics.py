@@ -150,7 +150,12 @@ class ServeMetrics:
     ``ring_capacity`` and ``ring_occupancy_hwm`` (high-water loop
     iterations a single dispatch used — at the capacity it means rings
     are filling and requests span drains); speculative engines add the
-    ``speculate`` config gauge (drafts per iteration, K).
+    ``speculate`` config gauge (drafts per iteration, K); engines that
+    know their KV pool footprint add ``kv_cache_bytes`` (total resident
+    KV bytes, quantization scales included) and ``kv_bytes_per_token``
+    (pool bytes per cache token-row — int8 caches publish roughly half
+    the bf16 figure).  All config gauges survive ``reset_metrics()``:
+    the engine re-passes them when it rebuilds this object.
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``tpot_s`` (per finished
     request: decode seconds per token after the first — the
@@ -186,6 +191,8 @@ class ServeMetrics:
         num_pages: Optional[int] = None,
         ring_capacity: Optional[int] = None,
         speculate: Optional[int] = None,
+        kv_cache_bytes: Optional[int] = None,
+        kv_bytes_per_token: Optional[int] = None,
     ):
         self.num_slots = int(num_slots)
         self.num_pages = num_pages if num_pages is None else int(num_pages)
@@ -193,6 +200,18 @@ class ServeMetrics:
             ring_capacity if ring_capacity is None else int(ring_capacity)
         )
         self.speculate = speculate if speculate is None else int(speculate)
+        # KV-footprint gauges (quantization-aware): total resident KV pool
+        # bytes (data + scales) and the per-token-row cost — int8 caches
+        # publish roughly half the bf16 figure, so dashboards can attribute
+        # capacity headroom to kv_dtype without re-deriving cache geometry.
+        self.kv_cache_bytes = (
+            kv_cache_bytes if kv_cache_bytes is None else int(kv_cache_bytes)
+        )
+        self.kv_bytes_per_token = (
+            kv_bytes_per_token
+            if kv_bytes_per_token is None
+            else int(kv_bytes_per_token)
+        )
         self.started_at = time.monotonic()
         self.counters: Dict[str, int] = {
             "requests_submitted": 0,
@@ -296,6 +315,10 @@ class ServeMetrics:
             gauges["ring_occupancy_hwm"] = self.ring_occupancy_hwm
         if self.speculate is not None:
             gauges["speculate"] = self.speculate
+        if self.kv_cache_bytes is not None:
+            gauges["kv_cache_bytes"] = self.kv_cache_bytes
+        if self.kv_bytes_per_token is not None:
+            gauges["kv_bytes_per_token"] = self.kv_bytes_per_token
         wall = time.monotonic() - self.started_at
         # decode-only tokens over decode-only time: prefill's sampled
         # token rides a prefill dispatch, so counting it here would
