@@ -54,6 +54,17 @@ def _train_throughput():
 
     import numpy as np
 
+    if os.environ.get("TDX_BENCH_ZERO2", "0") == "1":
+        import jax
+
+        if jax.device_count() < 2:
+            return {
+                "skipped": "zero2 needs >=2 devices",
+                "detail": f"{jax.device_count()} device(s) visible; the "
+                "ZeRO-2 A/B only runs on multi-device meshes (the CPU "
+                "smoke forces 8 virtual devices via XLA_FLAGS)",
+            }
+
     from torchdistx_tpu.utils.benchmarks import (
         V5E_PEAK_BF16 as _PEAK,
         build_train_workload,
@@ -67,6 +78,19 @@ def _train_throughput():
     t_phase0 = _time.perf_counter()
     n_steps = 20
     w = build_train_workload(n_steps)
+    if w.get("zero2"):
+        # the A/B verdicts, checked where the numbers are born — a
+        # failed assert surfaces as this phase's skipped record detail
+        dp = w["zero2_dp"]
+        assert w["optimizer_bytes_per_device"] < w["optimizer_bytes"], (
+            "zero2 did not shrink optimizer bytes/device: "
+            f"{w['optimizer_bytes_per_device']} of {w['optimizer_bytes']}"
+        )
+        pinned = w["zero2_participating_bytes"] * (dp - 1) // dp
+        assert w["zero2_step_wire_bytes"] == pinned, (
+            "zero2 step wire bytes off the ring closed form: "
+            f"{w['zero2_step_wire_bytes']} != {pinned}"
+        )
     run, carry = w["run"], w["carry"]
     flight.record(
         "bench_train_start", model=w["name"], steps=n_steps,
@@ -166,6 +190,17 @@ def _train_throughput():
         "remat_policy": w["remat_policy"],
         "optimizer": w["optimizer"],
         "fused_ce": w["fused_ce"],
+        "zero2": w["zero2"],
+        # plan/byte fields only present on the zero2 arm
+        **{
+            k: w[k]
+            for k in (
+                "plan", "zero2_dp", "optimizer_bytes",
+                "optimizer_bytes_per_device", "zero2_participating_bytes",
+                "zero2_step_wire_bytes",
+            )
+            if k in w
+        },
     }
 
 
@@ -323,7 +358,8 @@ def _ledger():
 
 
 def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
-            progress: str, kernels: dict, train_fused: dict) -> dict:
+            progress: str, kernels: dict, train_fused: dict,
+            train_zero2: dict) -> dict:
     """Assemble the (always-parseable) bench record from whatever ran."""
     train = dict(train)
     eager_ok = "total_s" in eager
@@ -354,6 +390,19 @@ def _record(train: dict, eager: dict, chunked: dict, preflight: dict,
                               "train_warm_converged", "fused_ce",
                               "train_model", "skipped", "detail")
                     if k in train_fused
+                },
+                # ZeRO-2 A/B leg (plan-sharded optimizer state over a
+                # dp mesh), trimmed to its verdict + pinned-byte fields
+                "train_zero2": {
+                    k: train_zero2[k]
+                    for k in ("tokens_per_sec", "mfu", "train_final_loss",
+                              "train_warm_converged", "zero2", "plan",
+                              "zero2_dp", "optimizer_bytes",
+                              "optimizer_bytes_per_device",
+                              "zero2_participating_bytes",
+                              "zero2_step_wire_bytes", "train_model",
+                              "skipped", "detail")
+                    if k in train_zero2
                 },
                 "deferred_init_s": eager.get("deferred_init_s"),
                 "materialize_s": eager.get("materialize_s"),
@@ -391,10 +440,11 @@ def main() -> None:
     kernels = dict(pending)
 
     def emit(train, eager, chunked, preflight, progress, kernels,
-             train_fused=None):
+             train_fused=None, train_zero2=None):
         # one full parseable record per phase boundary; last line wins
         rec = _record(train, eager, chunked, preflight, progress, kernels,
-                      train_fused if train_fused is not None else pending)
+                      train_fused if train_fused is not None else pending,
+                      train_zero2 if train_zero2 is not None else pending)
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -426,8 +476,8 @@ def main() -> None:
     # {"skipped": ...} record; a record line is emitted after each phase.
     # The kernel-acceptance sweep holds a RESERVE carved out of the
     # earlier phases' budgets (degrading the chunked A/B first): the
-    # phase caps alone (75+700+400+400+450+450 incl. the sweep and the
-    # fused-CE A/B) far overrun a 1500 s deadline, and
+    # phase caps alone (75+700+400+400+450+450+450 incl. the sweep and
+    # the fused-CE and ZeRO-2 A/Bs) far overrun a 1500 s deadline, and
     # without the reserve a slow-but-alive relay would always starve the
     # round's compiled-kernel evidence.
     sweep_reserve = min(350.0, left() * 0.25)
@@ -463,8 +513,25 @@ def main() -> None:
         min(450.0, left()),
         env=dict(os.environ, TDX_BENCH_FUSED_CE="1"),
     )
+    emit(train, eager, chunked, preflight, "train-fused-done", kernels,
+         train_fused)
+
+    # ZeRO-2 train A/B: the same train phase with the weight update
+    # sharded over a dp mesh spanning every visible device
+    # (parallel/plan.py).  The child asserts the verdict itself
+    # (optimizer bytes/device strictly drop; step wire bytes pinned to
+    # the ring closed form) and skips honestly on single-chip
+    # platforms; the CPU smoke forces 8 virtual devices so the A/B
+    # always runs in CI.
+    zenv = dict(os.environ, TDX_BENCH_ZERO2="1")
+    if zenv.get("TDX_BENCH_PLATFORM") == "cpu":
+        zenv["XLA_FLAGS"] = (
+            zenv.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    train_zero2 = _run_phase("--train-phase", min(450.0, left()), env=zenv)
     rec = emit(train, eager, chunked, preflight, "complete", kernels,
-               train_fused)
+               train_fused, train_zero2)
     # perf-sentinel hook: the finished record lands in LEDGER.jsonl as
     # normalized per-metric rows (never raises; TDX_LEDGER=0 disables)
     _ledger().append_record_rows(rec, source="bench")

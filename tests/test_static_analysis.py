@@ -144,6 +144,93 @@ class TestTDX101DonatedJit:
         findings, _ = _lint("import jax\nrun = jax.jit(step)\n")
         assert findings == []
 
+    # -- v2: the out_shardings VALUE must cite the plan ------------------
+
+    def test_hand_built_namedsharding_dict_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = {"w": NamedSharding(mesh, P("fsdp"))}
+            run = jax.jit(step, donate_argnums=(0,), out_shardings=(sh, None))
+            """
+        )
+        assert _rules_of(findings) == ["TDX101"]
+        assert "hand-built NamedSharding" in findings[0].message
+
+    def test_bare_namedsharding_literal_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            run = jax.jit(
+                step,
+                donate_argnums=(0,),
+                out_shardings=(NamedSharding(mesh, P()), None),
+            )
+            """
+        )
+        assert _rules_of(findings) == ["TDX101"]
+
+    def test_plan_shardings_for_satisfies(self):
+        findings, _ = _lint(
+            """\
+            import jax
+
+            run = jax.jit(
+                step,
+                donate_argnums=(0, 1),
+                out_shardings=plan.shardings_for(params, opt_state) + (None,),
+            )
+            """
+        )
+        assert findings == []
+
+    def test_tuple_unpack_from_plan_source_satisfies(self):
+        findings, _ = _lint(
+            """\
+            import jax
+
+            p_sh, o_sh = donated_carry_shardings(params, opt_state)
+            run = jax.jit(
+                step, donate_argnums=(0, 1), out_shardings=(p_sh, o_sh, None)
+            )
+            """
+        )
+        assert findings == []
+
+    def test_variable_holding_hand_built_dict_flagged(self):
+        findings, _ = _lint(
+            """\
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            carry_sh = (
+                {"w": NamedSharding(mesh, P("fsdp"))},
+                {"w": NamedSharding(mesh, P("fsdp"))},
+                None,
+            )
+            run = jax.jit(step, donate_argnums=(0, 1), out_shardings=carry_sh)
+            """
+        )
+        assert _rules_of(findings) == ["TDX101"]
+
+    def test_unknown_provenance_is_not_flagged(self):
+        # lexical rule: an opaque helper the linter cannot see into is
+        # given the benefit of the doubt (no NamedSharding in sight)
+        findings, _ = _lint(
+            """\
+            import jax
+
+            run = jax.jit(
+                step, donate_argnums=(0,), out_shardings=make_shardings()
+            )
+            """
+        )
+        assert findings == []
+
 
 class TestTDX102StatefulRng:
     def test_raw_prngkey_flagged(self):

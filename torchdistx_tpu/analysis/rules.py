@@ -58,39 +58,138 @@ def _has_splat(call: ast.Call) -> bool:
 # ---------------------------------------------------------------------------
 
 
+#: call names whose results count as plan-derived carry shardings — the
+#: ShardingPlan projections plus the engine/fsdp helpers they subsume
+_PLAN_SOURCES = {
+    "shardings_for",
+    "donated_carry_shardings",
+    "optimizer_state_shardings",
+    "param_shardings",
+    "carry_shardings",
+    "_out_shardings",
+}
+
+
 class DonatedJitNeedsOutShardings(Rule):
-    """TDX101 — donated jit without explicit ``out_shardings``.
+    """TDX101 — every donated carry cites a plan.
 
     Convention: jit does NOT propagate input shardings into outputs it
     considers fresh (zeros_like optimizer state, donated carries), so a
     ``donate_argnums=`` jit silently decays to replicated outputs unless
     ``out_shardings`` pins them (the optimizer-state/serve-carry lesson;
-    see parallel/fsdp.py optimizer_state_shardings).  A ``**kwargs``
-    splat counts as satisfied — the caller owns the decision there.
+    see parallel/plan.py).  A ``**kwargs`` splat counts as satisfied —
+    the caller owns the decision there.
+
+    v2 (plan engine): the *value* passed as ``out_shardings`` must be
+    plan-derived — ``plan.shardings_for(...)`` or one of the projections
+    it subsumes (``donated_carry_shardings``, ``optimizer_state_
+    shardings``, ``param_shardings``, ``carry_shardings``,
+    ``_out_shardings``), directly or via a local variable assigned from
+    such a call (tuple-unpack included).  A hand-built
+    ``NamedSharding(...)`` — bare, or inside a dict/list/tuple literal —
+    at a donation site is flagged: hand-rolled layouts drift from the
+    plan the audit and the ledger counters price, breaking
+    plan == audit == counters.
     """
 
     rule_id = "TDX101"
     severity = "error"
-    summary = "donated jit lacks explicit out_shardings"
+    summary = "donated jit lacks plan-derived out_shardings"
+
+    @staticmethod
+    def _var_exprs(tree: ast.AST) -> Dict[str, ast.AST]:
+        """name -> assigned value expr, for simple and tuple-unpack
+        assignments (each unpacked name inherits the RHS call)."""
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = value
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for i, el in enumerate(tgt.elts):
+                        if not isinstance(el, ast.Name):
+                            continue
+                        if isinstance(
+                            value, (ast.Tuple, ast.List)
+                        ) and i < len(value.elts):
+                            out[el.id] = value.elts[i]
+                        else:
+                            # p_sh, o_sh = plan.shardings_for(...):
+                            # each name inherits the call's provenance
+                            out[el.id] = value
+        return out
+
+    @staticmethod
+    def _call_names(expr: ast.AST, var_exprs: Dict[str, ast.AST]) -> Set[str]:
+        """Terminal callee names reachable from ``expr``, following local
+        Name references through ``var_exprs`` a few levels deep."""
+        names: Set[str] = set()
+        seen: Set[int] = set()
+        stack: List[Tuple[ast.AST, int]] = [(expr, 0)]
+        while stack:
+            node, depth = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    names.add(_last(_dotted(sub.func)))
+                elif (
+                    isinstance(sub, ast.Name)
+                    and depth < 3
+                    and sub.id in var_exprs
+                ):
+                    stack.append((var_exprs[sub.id], depth + 1))
+        return names
 
     def check(self, ctx: LintContext) -> List[Finding]:
         out = []
+        var_exprs = self._var_exprs(ctx.tree)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call) or not _is_jit_call(node):
                 continue
             if not _has_kwarg(node, "donate_argnums", "donate_argnames"):
                 continue
-            if _has_kwarg(node, "out_shardings") or _has_splat(node):
+            if _has_splat(node):
                 continue
-            out.append(
-                self.finding(
-                    ctx,
-                    node,
-                    "jit with donate_argnums but no out_shardings: donated "
-                    "carries decay to jit-chosen (usually replicated) "
-                    "layouts; pass out_shardings or forward **kwargs",
-                )
+            kw = next(
+                (k for k in node.keywords if k.arg == "out_shardings"), None
             )
+            if kw is None:
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "jit with donate_argnums but no out_shardings: "
+                        "donated carries decay to jit-chosen (usually "
+                        "replicated) layouts; pass plan-derived "
+                        "out_shardings (ShardingPlan.shardings_for) or "
+                        "forward **kwargs",
+                    )
+                )
+                continue
+            callees = self._call_names(kw.value, var_exprs)
+            if callees & _PLAN_SOURCES:
+                continue  # cites the plan (or a projection of it)
+            if "NamedSharding" in callees:
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "hand-built NamedSharding in a donated jit's "
+                        "out_shardings: derive the carry layouts from the "
+                        "plan (ShardingPlan.shardings_for / "
+                        "donated_carry_shardings) so the placement the "
+                        "step pins is the one the comm audit and ledger "
+                        "counters price (plan == audit == counters)",
+                    )
+                )
         return out
 
 

@@ -284,7 +284,11 @@ _SERVE_HIST_TIMINGS = ("ttft_s", "e2e_latency_s", "decode_token_s", "tpot_s")
 #: count and prefill/decode split the same way;
 #: ``scenario``/``autoscale`` split the open-loop autoscale phases per
 #: traffic scenario and per policy, so an autoscale-on run's scale-event
-#: pins can never collide with autoscale-off rows of the same scenario.
+#: pins can never collide with autoscale-off rows of the same scenario;
+#: ``plan`` names the declarative sharding plan (parallel/plan.py) a
+#: phase served under, keeping plan-driven rows distinct from the
+#: default TP wiring (None-filtered, so pre-plan fingerprints are
+#: byte-stable).
 _SERVE_WORKLOAD_KEYS = (
     "model",
     "requests",
@@ -304,6 +308,7 @@ _SERVE_WORKLOAD_KEYS = (
     "disaggregate",
     "scenario",
     "autoscale",
+    "plan",
 )
 
 
@@ -578,6 +583,12 @@ def ingest_bench_record(record: dict, **kw) -> List[dict]:
         "optimizer": extra.get("optimizer"),
         "fused_ce": extra.get("fused_ce"),
     }
+    # plan=/zero2= keys join the fingerprint only when the run actually
+    # used them, so pre-plan records' fingerprints stay byte-stable
+    if extra.get("zero2"):
+        train["zero2"] = True
+    if extra.get("plan") is not None:
+        train["plan"] = extra["plan"]
     train = {k: v for k, v in train.items() if v is not None}
     row("tokens_per_sec", record.get("tokens_per_sec"), "timing", train,
         unit="tok/s")
@@ -607,6 +618,26 @@ def ingest_bench_record(record: dict, **kw) -> List[dict]:
             train,
         )
     row("mfu_xla", extra.get("mfu_xla"), "timing", train)
+    # ZeRO-2 train A/B leg (extra.train_zero2): the update-sharding
+    # arm's deterministic byte counters pin EXACTLY (a silently
+    # un-sharded optimizer state regresses like a correctness bug);
+    # workload keys zero2=/plan= keep its rows from ever colliding with
+    # the replicated arm's
+    tz = extra.get("train_zero2") or {}
+    if isinstance(tz, dict) and tz.get("zero2"):
+        zw = {
+            "phase": "train",
+            "model": tz.get("train_model") or extra.get("train_model"),
+            "zero2": True,
+            "plan": tz.get("plan"),
+        }
+        zw = {k: v for k, v in zw.items() if v is not None}
+        row("tokens_per_sec", tz.get("tokens_per_sec"), "timing", zw,
+            unit="tok/s")
+        row("mfu", tz.get("mfu"), "timing", zw)
+        for k in ("optimizer_bytes", "optimizer_bytes_per_device",
+                  "zero2_participating_bytes", "zero2_step_wire_bytes"):
+            row(k, tz.get(k), "counter", zw, unit="B")
     # always at least one row, so even an all-null wedged-relay record
     # leaves a (degraded) mark in the trajectory
     row("bench_complete", int(complete), "counter", {"phase": "driver"})

@@ -247,8 +247,9 @@ def sharding_report(
                         "param": owner,
                         "bytes": _entry_bytes(leaf),
                         "detail": "param is sharded but this state slot is "
-                        "fully replicated — pass optimizer_state_shardings "
-                        "(parallel/fsdp.py) as out_shardings",
+                        "fully replicated — derive the slot shardings from "
+                        "the plan (ShardingPlan.optimizer_state_shardings, "
+                        "parallel/plan.py) and pass them as out_shardings",
                     }
                 )
 

@@ -14,6 +14,11 @@ from .gossip_grad import (
 )
 from .mesh import create_mesh, hierarchical_mesh, mesh_sharding, replicated
 from .multihost import init_multihost, is_multihost, process_count, process_index
+from .plan import (
+    PlanError,
+    ShardingPlan,
+    derive_optimizer_state_shardings,
+)
 from .pp import (
     pipeline_apply,
     pipeline_train_step,
@@ -24,12 +29,14 @@ from .reshard import (
     can_reshard_live,
     devices_hold_full_copy,
     plan_reshard,
+    plan_transition_wire_bytes,
     reshard,
+    reshard_to_plan,
     reshard_via_checkpoint,
     reshard_wire_bytes,
     split_counts,
 )
-from .tp import GSPMDTrainStep, llama_tp_rule, tp_shard_rule
+from .tp import GSPMDTrainStep, llama_tp_plan, llama_tp_rule, tp_shard_rule
 
 __all__ = [
     "collectives",
@@ -56,7 +63,9 @@ __all__ = [
     "can_reshard_live",
     "devices_hold_full_copy",
     "plan_reshard",
+    "plan_transition_wire_bytes",
     "reshard",
+    "reshard_to_plan",
     "reshard_via_checkpoint",
     "reshard_wire_bytes",
     "split_counts",
@@ -64,7 +73,11 @@ __all__ = [
     "pipeline_train_step",
     "split_microbatches",
     "stack_pipeline_stages",
+    "PlanError",
+    "ShardingPlan",
+    "derive_optimizer_state_shardings",
     "GSPMDTrainStep",
+    "llama_tp_plan",
     "llama_tp_rule",
     "tp_shard_rule",
 ]

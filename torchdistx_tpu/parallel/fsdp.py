@@ -143,61 +143,27 @@ def optimizer_state_shardings(state_shape: Any, params: Any, mesh: Mesh) -> Any:
     into ``zeros_like``-style outputs that never read the input values —
     without explicit out_shardings the whole optimizer state lands on one
     device regardless of how the parameters are sharded.
+
+    Deprecation shim: this is now a projection of the declarative plan
+    engine — ``ShardingPlan.optimizer_state_shardings`` (parallel/plan.py)
+    derives the same slot inheritance from the plan's RULES (plus the
+    ZeRO-2 augmentation), and new code should hold a plan rather than
+    call this directly.  This entry point keeps working for trees placed
+    by hand: slots inherit each parameter's ACTUAL sharding.
     """
+    from .plan import derive_optimizer_state_shardings
+
     repl = NamedSharding(mesh, P())
-    psh = jax.tree_util.tree_map(
-        lambda p: p.sharding if isinstance(p, jax.Array) else repl, params
-    )
-    # per-leaf-path shardings: lets param-slot subtrees WITH HOLES match
-    # (optax.masked / multi_transform moment trees carry MaskedNode where
-    # another group's params sit — structurally != params, but every leaf
-    # they do have is a param slot)
-    ppaths = {
-        jax.tree_util.keystr(path): sh
-        for path, sh in jax.tree_util.tree_flatten_with_path(psh)[0]
-    }
-    pshapes = {
-        jax.tree_util.keystr(path): getattr(leaf, "shape", None)
-        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
-    }
 
-    def shape_matches(path_str: str, leaf: Any) -> bool:
-        # a param-named leaf must also be param-SIZED to inherit the
-        # param's sharding: factored optimizers (Adafactor-style row/col
-        # second moments) keep the param's tree paths with differently
-        # shaped leaves, and the param's PartitionSpec would mis-shard
-        # (or outright fail to apply to) those
-        p_shape = pshapes.get(path_str)
-        l_shape = getattr(leaf, "shape", None)
+    def sharding_of(_path: str, param_leaf: Any):
         return (
-            p_shape is None
-            or l_shape is None
-            or tuple(l_shape) == tuple(p_shape)
+            param_leaf.sharding
+            if isinstance(param_leaf, jax.Array)
+            else repl
         )
 
-    def is_param_like(t: Any) -> bool:
-        leaves = jax.tree_util.tree_flatten_with_path(t)[0]
-        return bool(leaves) and all(
-            jax.tree_util.keystr(p) in ppaths for p, _ in leaves
-        )
-
-    def shard_tree(t: Any) -> Any:
-        # shape gating is PER LEAF, so one mis-sized leaf (a row factor)
-        # replicates only itself — its exactly-param-sized siblings in
-        # the same slot subtree keep their param shardings
-        return jax.tree_util.tree_map_with_path(
-            lambda p, leaf: (
-                ppaths[jax.tree_util.keystr(p)]
-                if shape_matches(jax.tree_util.keystr(p), leaf)
-                else repl
-            ),
-            t,
-        )
-
-    return jax.tree_util.tree_map(
-        lambda t: shard_tree(t) if is_param_like(t) else repl,
-        state_shape,
-        is_leaf=is_param_like,
+    return derive_optimizer_state_shardings(
+        state_shape, params, mesh, sharding_of
     )
 
 
@@ -277,10 +243,25 @@ class ShardedTrainStep:
     # all-gathered once per step, not per microbatch); gradients accumulate
     # in f32 and the comm hook runs once, on the accumulated gradient
     accum_steps: int = 1
+    # the declarative plan this step's placements realize.  Defaults to
+    # ShardingPlan.fsdp(mesh, shard_axis) for the plain (non-divergent)
+    # layouts, whose specs are exactly param_spec's — one object the
+    # Trainer can with_mesh() through an elastic reshard.  Divergent-
+    # replica layouts (leading per-replica dim) stay plan-less: their
+    # lead-dim specs are not expressible as path rules.
+    plan: Optional[Any] = None
 
     def __post_init__(self) -> None:
         if self.hook_state is None:
             self.hook_state = DefaultState()
+        if (
+            self.plan is None
+            and self.shard_axis is not None
+            and not self.divergent_replicas
+        ):
+            from .plan import ShardingPlan
+
+            self.plan = ShardingPlan.fsdp(self.mesh, self.shard_axis)
         if self.batch_axes is None:
             axes = list(self.replica_axes)
             if self.shard_axis is not None:

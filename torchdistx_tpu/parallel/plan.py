@@ -1,0 +1,554 @@
+"""Declarative sharding plans: ONE object that owns a model's placement.
+
+Before this module, sharding knowledge lived in four places: per-model
+regex rules in ``parallel/tp.py``, the manual ``optimizer_state_shardings``
+escape hatch, per-leg wiring in ``__graft_entry__.py``, and
+``fsdp.donated_carry_shardings()`` pinning donated jit carries under the
+TDX101 lint rule.  A :class:`ShardingPlan` collapses all four into one
+frozen value: ordered ``regex-over-param-path -> PartitionSpec`` rules
+(first match wins, t5x ``match_partition_rules`` style) from which every
+other placement is DERIVED —
+
+- parameter shardings (:meth:`param_shardings`, :meth:`as_rule` for
+  ``materialize_module(sharding_rule=...)`` and
+  ``obs.memory.sharding_report(intended_rule=...)``),
+- optimizer-state shardings (:meth:`optimizer_state_shardings` — slot
+  subtrees inherit their parameter's rule, shape-gated per leaf so a
+  factored moment replicates only itself),
+- donated jit carries (:meth:`shardings_for` — the TDX101 citation),
+- KV pools (a ``kv_cache`` pseudo-path rule, :meth:`maybe_spec_for`).
+
+Validation and pricing are part of the contract, not an afterthought:
+:meth:`validate` runs the plan against ``obs/memory.sharding_report`` +
+``capacity_plan`` and raises :class:`PlanError` naming per-device budgets
+when the plan doesn't fit; :meth:`price_step` computes the plan's
+per-step collective footprint closed-form from the rules alone via the
+``obs/comm.py`` ring model, and :meth:`record_step_collectives` books
+exactly those rows into the comm audit — plan == audit == ledger
+counters, the same discipline ``parallel/reshard.py`` established for
+redistributions.
+
+ZeRO-2 (arXiv:2004.13336, automatic cross-replica weight-update
+sharding): construct the plan with ``dp_axis=... , zero2=True``.  When
+the rules REPLICATE a parameter over the DP axis, the derived optimizer
+slots for it are sharded over that axis anyway; pinning those shardings
+on a donated train-step carry makes XLA compute the (elementwise)
+update sharded and all-gather the updated parameters — optimizer memory
+drops to ``1/dp`` and the step pays one ``(n-1)/n * param_bytes``
+all-gather, both priced here closed-form.  Because the update math is
+elementwise, the result is BITWISE identical to a replicated-optimizer
+step (asserted by tests/test_plan.py and the ``zero2`` dryrun leg).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .fsdp import donated_carry_shardings, fsdp_partition_spec
+
+__all__ = [
+    "PlanError",
+    "ShardingPlan",
+    "derive_optimizer_state_shardings",
+    "tree_path_str",
+]
+
+
+class PlanError(ValueError):
+    """A sharding plan failed validation (mis-sharded leaves, budget
+    overshoot).  Always raised with the offending paths / named
+    per-device budgets in the message — a bad plan fails LOUDLY at
+    materialize time, never as a silent OOM ten minutes into a run."""
+
+
+def tree_path_str(path: Sequence[Any]) -> str:
+    """Dotted param-path for a ``tree_flatten_with_path`` key path.
+
+    ``{"tok_emb.weight": ...}`` flattens to ``DictKey('tok_emb.weight')``
+    — this renders it back to ``"tok_emb.weight"`` so plan regexes match
+    the same strings ``materialize_module`` hands its sharding rule.
+    """
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds degrade readably
+            parts.append(str(k))
+    return ".".join(parts)
+
+
+def _spec_axes(spec: P) -> list:
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        axes.extend(entry if isinstance(entry, tuple) else (entry,))
+    return axes
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+
+
+def derive_optimizer_state_shardings(
+    state_shape: Any,
+    params: Any,
+    mesh: Mesh,
+    sharding_of: Callable[[str, Any], Any],
+    *,
+    replicated_override: Optional[Callable[[str, Any], Any]] = None,
+) -> Any:
+    """Shared optimizer-state sharding engine (plan AND legacy paths).
+
+    Optimizer slot subtrees structurally equal to ``params`` (optax's
+    per-parameter slots, including subtrees with ``MaskedNode`` holes)
+    inherit ``sharding_of(path, param_leaf)``; everything else (step
+    counters, ...) replicates.  Shape gating is PER LEAF: a slot leaf
+    that is param-named but not param-SIZED (Adafactor row/col factors)
+    replicates only itself — its exactly-param-sized siblings keep the
+    param sharding.
+
+    ``replicated_override(path, slot_leaf)``, when given, replaces the
+    plain-replicated fallback for leaves inside param slots — the ZeRO-2
+    hook: a slot whose parameter the plan replicates gets dp-sharded by
+    its OWN shape instead.
+    """
+    repl = NamedSharding(mesh, P())
+    keystr = jax.tree_util.keystr
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    ppaths = {keystr(p): (tree_path_str(p), leaf) for p, leaf in flat_params}
+
+    def param_sharding(path_str: str) -> Any:
+        dotted, leaf = ppaths[path_str]
+        return sharding_of(dotted, leaf)
+
+    def shape_matches(path_str: str, leaf: Any) -> bool:
+        p_shape = getattr(ppaths[path_str][1], "shape", None)
+        l_shape = getattr(leaf, "shape", None)
+        return (
+            p_shape is None
+            or l_shape is None
+            or tuple(l_shape) == tuple(p_shape)
+        )
+
+    def is_param_like(t: Any) -> bool:
+        leaves = jax.tree_util.tree_flatten_with_path(t)[0]
+        return bool(leaves) and all(keystr(p) in ppaths for p, _ in leaves)
+
+    def slot_fallback(path, leaf: Any) -> Any:
+        if replicated_override is not None:
+            return replicated_override(tree_path_str(path), leaf)
+        return repl
+
+    def shard_tree(t: Any) -> Any:
+        def leaf_sharding(path, leaf):
+            ks = keystr(path)
+            if not shape_matches(ks, leaf):
+                return slot_fallback(path, leaf)
+            sh = param_sharding(ks)
+            if _is_replicated_sharding(sh):
+                return slot_fallback(path, leaf)
+            return sh
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, t)
+
+    return jax.tree_util.tree_map(
+        lambda t: shard_tree(t) if is_param_like(t) else repl,
+        state_shape,
+        is_leaf=is_param_like,
+    )
+
+
+def _is_replicated_sharding(sh: Any) -> bool:
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return False
+    return not _spec_axes(spec)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """A frozen, declarative sharding plan over one mesh.
+
+    Args:
+      mesh: the device mesh every derived sharding targets.
+      rules: ordered ``(regex, PartitionSpec)`` pairs matched against
+        dotted parameter paths with ``re.search`` — FIRST match wins
+        (t5x ``match_partition_rules``).  An explicit rule always
+        applies, even to tiny tensors.
+      default_axis: placement for paths no rule matches — ``None``
+        replicates them; a mesh axis name FSDP-shards them on their
+        first divisible dim (``fsdp_partition_spec``, honoring
+        ``min_shard_elems``).
+      dp_axis: the data-parallel axis ZeRO-2 shards weight updates over.
+      zero2: when True, optimizer slots whose parameter the plan
+        replicates are sharded over ``dp_axis`` by their own shape, and
+        :meth:`price_step` / :meth:`record_step_collectives` account the
+        per-step updated-parameter all-gather.
+      min_shard_elems: tensors smaller than this stay replicated on the
+        fallback/ZeRO-2 paths (sharding a 4-element bias costs more in
+        collective latency than it saves).
+    """
+
+    mesh: Mesh
+    rules: tuple = ()
+    default_axis: Optional[str] = None
+    dp_axis: Optional[str] = None
+    zero2: bool = False
+    min_shard_elems: int = 1024
+
+    def __post_init__(self) -> None:
+        rules = tuple((str(pat), spec) for pat, spec in self.rules)
+        object.__setattr__(self, "rules", rules)
+        object.__setattr__(
+            self, "_compiled", tuple((re.compile(p), s) for p, s in rules)
+        )
+        axis_names = set(self.mesh.axis_names)
+        for name in ("default_axis", "dp_axis"):
+            ax = getattr(self, name)
+            if ax is not None and ax not in axis_names:
+                raise PlanError(
+                    f"{name}={ax!r} is not a mesh axis (mesh has "
+                    f"{sorted(axis_names)})"
+                )
+        for pat, spec in rules:
+            for ax in _spec_axes(spec):
+                if ax not in axis_names:
+                    raise PlanError(
+                        f"rule {pat!r} -> {spec} references axis {ax!r} "
+                        f"not in mesh axes {sorted(axis_names)}"
+                    )
+        if self.zero2 and self.dp_axis is None:
+            raise PlanError("zero2=True requires dp_axis=")
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def fsdp(
+        cls, mesh: Mesh, axis: str = "fsdp", min_shard_elems: int = 1024
+    ) -> "ShardingPlan":
+        """The classic FSDP plan: no explicit rules, every param falls
+        back to first-divisible-dim sharding over ``axis``."""
+        return cls(mesh, rules=(), default_axis=axis,
+                   min_shard_elems=min_shard_elems)
+
+    @classmethod
+    def replicated(cls, mesh: Mesh) -> "ShardingPlan":
+        """Everything replicated — the explicit do-nothing plan."""
+        return cls(mesh, rules=())
+
+    def with_mesh(self, mesh: Mesh) -> "ShardingPlan":
+        """The same rules over a different mesh — reshard's target-plan
+        constructor (reshard = source plan -> target plan)."""
+        return dataclasses.replace(self, mesh=mesh)
+
+    # -- rule resolution ---------------------------------------------------
+
+    def maybe_spec_for(self, path: str, shape: Sequence[int]) -> Optional[P]:
+        """First matching rule's spec, or ``None`` when no rule matches
+        (callers with their own fallback, e.g. the serve KV pool)."""
+        for pat, spec in self._compiled:
+            if pat.search(path):
+                return spec
+        return None
+
+    def spec_for(self, path: str, shape: Sequence[int]) -> P:
+        """The plan's PartitionSpec for one parameter path."""
+        spec = self.maybe_spec_for(path, shape)
+        if spec is not None:
+            return spec
+        if self.default_axis is not None:
+            return fsdp_partition_spec(
+                tuple(shape), self.mesh, self.default_axis,
+                self.min_shard_elems,
+            )
+        return P()
+
+    def sharding_for(self, path: str, like: Any) -> NamedSharding:
+        return NamedSharding(
+            self.mesh, self.spec_for(path, getattr(like, "shape", ()))
+        )
+
+    def as_rule(self) -> Callable[[str, Any], NamedSharding]:
+        """``(path, like) -> NamedSharding`` — the exact signature of
+        ``materialize_module(sharding_rule=)`` AND
+        ``obs.memory.sharding_report(intended_rule=)``, so the plan that
+        places the params is the plan the audit checks them against."""
+        return self.sharding_for
+
+    def describe(self, params: Any) -> dict:
+        """``{path: PartitionSpec}`` over a param tree — debugging aid
+        and the docs' worked examples."""
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            p = tree_path_str(path)
+            out[p] = self.spec_for(p, getattr(leaf, "shape", ()))
+        return out
+
+    # -- derived placements ------------------------------------------------
+
+    def param_shardings(self, params: Any) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.sharding_for(tree_path_str(path), leaf),
+            params,
+        )
+
+    def apply(self, params: Any) -> Any:
+        """Place (or re-place) a param tree per the plan.  Leaves already
+        equivalently placed are passed through untouched (zero-copy for
+        the materialize handoff)."""
+        def place(path, leaf):
+            target = self.sharding_for(tree_path_str(path), leaf)
+            cur = getattr(leaf, "sharding", None)
+            if cur is not None and cur.is_equivalent_to(
+                target, getattr(leaf, "ndim", 0)
+            ):
+                return leaf
+            return jax.device_put(leaf, target)
+
+        return jax.tree_util.tree_map_with_path(place, params)
+
+    def _zero2_slot_override(self) -> Optional[Callable[[str, Any], Any]]:
+        if not self.zero2:
+            return None
+        mesh, dp, min_elems = self.mesh, self.dp_axis, self.min_shard_elems
+
+        def override(path: str, leaf: Any) -> NamedSharding:
+            spec = fsdp_partition_spec(
+                tuple(getattr(leaf, "shape", ()) or ()), mesh, dp, min_elems
+            )
+            return NamedSharding(mesh, spec)
+
+        return override
+
+    def optimizer_state_shardings(self, state_shape: Any, params: Any) -> Any:
+        """Optimizer-state shardings derived from the param rules —
+        kills the manual ``optimizer_state_shardings`` call sites.  With
+        ``zero2=True``, slots whose parameter the plan replicates are
+        sharded over ``dp_axis`` by their own shape (the ZeRO-2 memory
+        win); everything non-slot (step counters) replicates."""
+        return derive_optimizer_state_shardings(
+            state_shape,
+            params,
+            self.mesh,
+            lambda path, leaf: self.sharding_for(path, leaf),
+            replicated_override=self._zero2_slot_override(),
+        )
+
+    def shardings_for(self, *trees: Any) -> tuple:
+        """Per-tree donated-carry ``out_shardings`` (the TDX101
+        citation): each concrete leaf keeps its ACTUAL placement — for
+        plan-placed trees that IS the plan's placement, and jit keeps
+        free choice (``None``) for abstract/numpy leaves."""
+        return donated_carry_shardings(*trees)
+
+    # -- validation --------------------------------------------------------
+
+    def validate(
+        self,
+        params: Any,
+        *,
+        optimizer_state: Any = None,
+        budget_bytes_per_device: Optional[int] = None,
+        budget_name: str = "device HBM",
+    ) -> dict:
+        """Check a (materialized or shape-only) state against the plan.
+
+        Materialized trees (every leaf a ``jax.Array``) run through
+        ``obs.memory.sharding_report`` with this plan as the intended
+        rule; ANY flag raises :class:`PlanError` with the per-entry
+        details.  Shape-only trees (``jax.ShapeDtypeStruct``) are priced
+        closed-form — per-device bytes from the rules alone — and
+        gated through ``obs.memory.capacity_plan``.  Both paths name the
+        budget (``budget_name`` @ ``budget_bytes_per_device``) in the
+        failure, so an overshooting plan dies at plan time with numbers,
+        not at step 400 with an OOM."""
+        from ..obs import memory as obs_memory
+
+        leaves = jax.tree_util.tree_leaves(params) + (
+            jax.tree_util.tree_leaves(optimizer_state)
+            if optimizer_state is not None
+            else []
+        )
+        materialized = bool(leaves) and all(
+            isinstance(x, jax.Array) for x in leaves
+        )
+        if materialized:
+            report = obs_memory.sharding_report(
+                params,
+                intended_rule=self.as_rule(),
+                optimizer_state=optimizer_state,
+                min_shard_elems=self.min_shard_elems,
+                budget_bytes_per_device=budget_bytes_per_device,
+            )
+            if report.get("flags"):
+                budget = (
+                    f"{budget_name} budget "
+                    f"{budget_bytes_per_device} bytes/device"
+                    if budget_bytes_per_device is not None
+                    else "no per-device budget"
+                )
+                raise PlanError(
+                    f"sharding plan validation failed ({budget}): "
+                    f"flags={report['flags']}"
+                )
+            return report
+
+        components = {
+            "params": self.per_device_bytes(params),
+        }
+        if optimizer_state is not None:
+            opt_sh = self.optimizer_state_shardings(optimizer_state, params)
+            components["optimizer_state"] = self._per_device_bytes_with(
+                optimizer_state, opt_sh
+            )
+        plan_doc = obs_memory.capacity_plan(
+            components, budget_bytes=budget_bytes_per_device
+        )
+        if budget_bytes_per_device is not None and not plan_doc["fits"]:
+            raise PlanError(
+                f"sharding plan overshoots the {budget_name} budget: "
+                f"projected {plan_doc['projected_peak_bytes']} bytes/device"
+                f" > {budget_bytes_per_device} bytes/device "
+                f"(headroom {plan_doc['headroom_bytes']}); components="
+                f"{plan_doc['components']}"
+            )
+        return plan_doc
+
+    def _num_shards(self, spec: P) -> int:
+        n = 1
+        for ax in _spec_axes(spec):
+            n *= int(self.mesh.shape[ax])
+        return n
+
+    def per_device_bytes(self, params: Any) -> int:
+        """Closed-form per-device parameter bytes under the plan."""
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            spec = self.spec_for(
+                tree_path_str(path), getattr(leaf, "shape", ())
+            )
+            total += _leaf_bytes(leaf) // self._num_shards(spec)
+        return total
+
+    def _per_device_bytes_with(self, tree: Any, shardings: Any) -> int:
+        total = 0
+        for leaf, sh in zip(
+            jax.tree_util.tree_leaves(tree),
+            jax.tree_util.tree_leaves(
+                shardings, is_leaf=lambda s: isinstance(s, NamedSharding)
+            ),
+        ):
+            spec = getattr(sh, "spec", P())
+            total += _leaf_bytes(leaf) // self._num_shards(spec)
+        return total
+
+    # -- closed-form pricing (plan == audit == counters) -------------------
+
+    def zero2_participating_bytes(self, params: Any) -> int:
+        """Bytes of the params whose update ZeRO-2 actually shards: plan
+        replicates them, and their own shape dp-shards above the
+        ``min_shard_elems`` floor.  The per-step all-gather payload."""
+        if not self.zero2:
+            return 0
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            spec = self.spec_for(tree_path_str(path), shape)
+            if _spec_axes(spec):
+                continue  # plan shards the param itself; not a zero2 leaf
+            dp_spec = fsdp_partition_spec(
+                shape, self.mesh, self.dp_axis, self.min_shard_elems
+            )
+            if _spec_axes(dp_spec):
+                total += _leaf_bytes(leaf)
+        return total
+
+    def price_step(self, params: Any) -> list:
+        """The plan's per-train-step collective footprint, computed from
+        the rules alone via the ``obs/comm.py`` ring model.  Returns
+        rows ``{kind, axis, payload_bytes, wire_bytes, count}`` matching
+        EXACTLY what the corresponding step books into the comm audit:
+
+        - ``default_axis`` (FSDP) plans price the per-leaf param
+          all-gather + gradient reduce-scatter (payload = full leaf
+          bytes, ``ShardedTrainStep``'s booking convention) and a pmean
+          for unsharded-param gradients;
+        - ``zero2`` plans price ONE updated-params all-gather over
+          ``dp_axis`` per step, payload = participating param bytes,
+          wire ``(n-1)/n * payload``.
+
+        Scalar loss-reduction pmeans (4-byte payloads) are not priced.
+        """
+        from ..obs.comm import _WIRE
+
+        rows = []
+
+        def row(kind: str, axis: str, payload: int, count: int = 1):
+            n = int(self.mesh.shape[axis])
+            ratio = _WIRE.get(kind)
+            wire = payload * ratio(n, None) if ratio else float(payload)
+            rows.append(
+                {
+                    "kind": kind,
+                    "axis": axis,
+                    "payload_bytes": int(payload),
+                    "wire_bytes": int(round(wire * count)),
+                    "count": int(count),
+                    "axis_size": n,
+                }
+            )
+
+        if self.zero2:
+            payload = self.zero2_participating_bytes(params)
+            if payload:
+                row("all_gather", self.dp_axis, payload)
+        if self.default_axis is not None:
+            axis = self.default_axis
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+                spec = self.spec_for(
+                    tree_path_str(path), getattr(leaf, "shape", ())
+                )
+                if axis in _spec_axes(spec):
+                    row("all_gather", axis, _leaf_bytes(leaf))
+                    row("reduce_scatter", axis, _leaf_bytes(leaf))
+                else:
+                    row("pmean", axis, _leaf_bytes(leaf))
+        return rows
+
+    def step_wire_bytes(self, params: Any, kind: Optional[str] = None) -> int:
+        """Total closed-form wire bytes per step (optionally one kind)."""
+        return sum(
+            r["wire_bytes"]
+            for r in self.price_step(params)
+            if kind is None or r["kind"] == kind
+        )
+
+    def record_step_collectives(self, params: Any) -> None:
+        """Book :meth:`price_step`'s rows into the ambient comm audit —
+        the analytic-at-dispatch idiom for GSPMD collectives the tracer
+        never sees (cached programs record nothing; XLA inserts the
+        ZeRO-2 gather itself).  Calling this once per dispatched step
+        makes a k-step audit equal k x the closed form exactly."""
+        from ..obs.comm import record_collective
+
+        for r in self.price_step(params):
+            record_collective(
+                r["kind"],
+                r["axis"],
+                payload_bytes=r["payload_bytes"],
+                count=r["count"],
+                axis_size=r["axis_size"],
+            )

@@ -50,8 +50,10 @@ from ..obs.comm import record_collective
 
 __all__ = [
     "plan_reshard",
+    "plan_transition_wire_bytes",
     "split_counts",
     "reshard",
+    "reshard_to_plan",
     "reshard_wire_bytes",
     "devices_hold_full_copy",
     "can_reshard_live",
@@ -255,3 +257,49 @@ def reshard_via_checkpoint(
                     axis_size=n,
                 )
     return out
+
+
+def plan_transition_wire_bytes(
+    params: Any, target_plan: Any, *, optimizer_state: Any = None
+) -> int:
+    """Closed-form wire bytes of moving live state from wherever it sits
+    into ``target_plan``'s placements (params + derived optimizer
+    slots) — what :func:`reshard_to_plan` will book, priced as pure host
+    arithmetic before committing to the move."""
+    total = reshard_wire_bytes(params, target_plan.param_shardings(params))
+    if optimizer_state is not None:
+        total += reshard_wire_bytes(
+            optimizer_state,
+            target_plan.optimizer_state_shardings(optimizer_state, params),
+        )
+    return total
+
+
+def reshard_to_plan(
+    params: Any,
+    target_plan: Any,
+    *,
+    optimizer_state: Any = None,
+    record: bool = True,
+):
+    """Plan-level redistribution: reshard = source plan -> target plan.
+
+    The source "plan" is whatever the live arrays' shardings realize;
+    the target is a :class:`~.plan.ShardingPlan` (typically
+    ``old_plan.with_mesh(new_mesh)``), which derives BOTH the parameter
+    targets and the optimizer-slot targets — so an elastic transition
+    never hand-assembles optimizer shardings again.  Returns ``params``
+    (or ``(params, optimizer_state)`` when state is given), with each
+    leaf's gather booked into the active comm audit exactly as
+    :func:`reshard` does."""
+    new_params = reshard(
+        params, target_plan.param_shardings(params), record=record
+    )
+    if optimizer_state is None:
+        return new_params
+    new_state = reshard(
+        optimizer_state,
+        target_plan.optimizer_state_shardings(optimizer_state, new_params),
+        record=record,
+    )
+    return new_params, new_state

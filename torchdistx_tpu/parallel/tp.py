@@ -19,7 +19,6 @@ Two pieces:
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -28,12 +27,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .fsdp import (
     accumulate_grads,
     donated_carry_shardings,
-    fsdp_partition_spec,
     optimizer_state_shardings,
     strided_split,
 )
+from .plan import ShardingPlan
 
-__all__ = ["tp_shard_rule", "llama_tp_rule", "shard_params", "GSPMDTrainStep"]
+__all__ = [
+    "tp_shard_rule",
+    "llama_tp_plan",
+    "llama_tp_rule",
+    "shard_params",
+    "GSPMDTrainStep",
+]
 
 
 def tp_shard_rule(
@@ -47,20 +52,47 @@ def tp_shard_rule(
 
     Unmatched parameters are replicated, or FSDP-sharded over
     ``default_axis`` when given.
+
+    Deprecation shim: this is now a projection of the declarative plan
+    engine — prefer holding the :class:`~.plan.ShardingPlan` itself
+    (``ShardingPlan(mesh, rules=patterns, default_axis=...)``), which
+    additionally derives optimizer-state/carry shardings, validates,
+    and prices the layout.
     """
-    compiled = [(re.compile(pat), spec) for pat, spec in patterns]
+    return ShardingPlan(
+        mesh, rules=tuple(patterns), default_axis=default_axis
+    ).as_rule()
 
-    def rule(path: str, like: Any) -> NamedSharding:
-        for rx, spec in compiled:
-            if rx.search(path):
-                return NamedSharding(mesh, spec)
-        if default_axis is not None:
-            return NamedSharding(
-                mesh, fsdp_partition_spec(like.shape, mesh, default_axis)
-            )
-        return NamedSharding(mesh, P())
 
-    return rule
+def llama_tp_plan(
+    mesh: Mesh,
+    tp_axis: str = "tp",
+    fsdp_axis: Optional[str] = None,
+    **plan_kwargs: Any,
+) -> ShardingPlan:
+    """Megatron-style TP :class:`~.plan.ShardingPlan` for
+    :class:`~torchdistx_tpu.models.Llama`.
+
+    Column-parallel (shard output features) for qkv and MLP up/gate;
+    row-parallel (shard input features) for the attention output and MLP
+    down projections — so each block needs exactly one reduce per
+    sub-layer, which XLA inserts.  Embedding and head shard over vocab.
+    With ``fsdp_axis``, the other matrix dim is additionally FSDP-sharded
+    (2D TP x FSDP).  The plan also carries the serve KV pool's layout as
+    the ``kv_cache`` pseudo-path rule (pages sharded over heads on
+    ``tp_axis`` — dim 2 of the (slots, pages, heads, head_dim) pool).
+    """
+    f = fsdp_axis  # may be None -> replicated on that dim
+    rules = (
+        (r"\.(wq|wk|wv)\.weight$", P(tp_axis, f)),
+        (r"\.wo\.weight$", P(f, tp_axis)),
+        (r"\.(w_gate|w_up)\.weight$", P(tp_axis, f)),
+        (r"\.w_down\.weight$", P(f, tp_axis)),
+        (r"tok_emb\.weight$", P(tp_axis, f)),
+        (r"lm_head\.weight$", P(tp_axis, f)),
+        (r"^kv_cache$", P(None, None, tp_axis, None)),
+    )
+    return ShardingPlan(mesh, rules=rules, **plan_kwargs)
 
 
 def llama_tp_rule(
@@ -68,25 +100,11 @@ def llama_tp_rule(
     tp_axis: str = "tp",
     fsdp_axis: Optional[str] = None,
 ) -> Callable[[str, Any], NamedSharding]:
-    """Megatron-style TP layout for :class:`~torchdistx_tpu.models.Llama`.
-
-    Column-parallel (shard output features) for qkv and MLP up/gate;
-    row-parallel (shard input features) for the attention output and MLP
-    down projections — so each block needs exactly one reduce per
-    sub-layer, which XLA inserts.  Embedding and head shard over vocab.
-    With ``fsdp_axis``, the other matrix dim is additionally FSDP-sharded
-    (2D TP x FSDP).
-    """
-    f = fsdp_axis  # may be None -> replicated on that dim
-    patterns = [
-        (r"\.(wq|wk|wv)\.weight$", P(tp_axis, f)),
-        (r"\.wo\.weight$", P(f, tp_axis)),
-        (r"\.(w_gate|w_up)\.weight$", P(tp_axis, f)),
-        (r"\.w_down\.weight$", P(f, tp_axis)),
-        (r"tok_emb\.weight$", P(tp_axis, f)),
-        (r"lm_head\.weight$", P(tp_axis, f)),
-    ]
-    return tp_shard_rule(mesh, patterns)
+    """Deprecation shim: :func:`llama_tp_plan`'s rule projection.  New
+    code should pass the plan object around (``ServeEngine(plan=...)``,
+    ``materialize_module(sharding_rule=plan.as_rule())``) instead of a
+    bare rule callable."""
+    return llama_tp_plan(mesh, tp_axis, fsdp_axis).as_rule()
 
 
 def shard_params(
@@ -121,6 +139,16 @@ class GSPMDTrainStep:
 
     Use when no gradient comm hook is needed — for hooks (GossipGraD,
     SlowMo) use :class:`ShardedTrainStep`.
+
+    With ``plan=`` the step is plan-driven: optimizer state is created
+    under the plan's derived shardings and the donated carry cites
+    ``plan.shardings_for`` (TDX101).  A ``zero2=True`` plan turns this
+    into an automatic ZeRO-2 step (arXiv:2004.13336): the carry pins
+    params replicated but optimizer slots dp-sharded, so XLA computes
+    the elementwise update sharded and all-gathers the updated params —
+    the step books that gather's ring closed form into the comm audit
+    at every dispatch (GSPMD collectives are invisible to the Python
+    tracer; plan == audit == counters).
     """
 
     loss_fn: Callable[[Any, Any], jax.Array]
@@ -131,6 +159,7 @@ class GSPMDTrainStep:
     # split into accum_steps microbatches scanned sequentially, gradients
     # accumulated in f32 — the standard fit-a-bigger-batch lever
     accum_steps: int = 1
+    plan: Optional[ShardingPlan] = None
 
     def __post_init__(self) -> None:
         opt = self.optimizer
@@ -156,18 +185,31 @@ class GSPMDTrainStep:
         # placements are known (and rebuildable: elastic reshard resets
         # _jitted to None when the mesh changes under the step)
         self._jitted = None
+        self._step_rows: tuple = ()
         self._warned_shardings: set = set()
 
     def _build(self, params: Any, opt_state: Any) -> None:
         # donated carries keep their arrival layouts (TDX101): GSPMD
         # propagation covers values the outputs READ, but pinning
         # out_shardings keeps fresh outputs (optimizer zeros, dtype
-        # casts) from decaying to jit-chosen placements
-        p_sh, o_sh = donated_carry_shardings(params, opt_state)
+        # casts) from decaying to jit-chosen placements.  For ZeRO-2
+        # these pins ARE the mechanism: sharded opt slots + replicated
+        # params force XLA to compute the update sharded and gather.
+        if self.plan is not None:
+            p_sh, o_sh = self.plan.shardings_for(params, opt_state)
+        else:
+            p_sh, o_sh = donated_carry_shardings(params, opt_state)
         self._jitted = jax.jit(
             self._step,
             donate_argnums=(0, 1),
             out_shardings=(p_sh, o_sh, None),
+        )
+        # the ZeRO-2 gather's closed form, priced once from shape/dtype
+        # metadata (stable across donation) and booked per dispatch
+        self._step_rows = (
+            self.plan.price_step(params)
+            if self.plan is not None and self.plan.zero2
+            else ()
         )
         from ..obs.recompile import track_jit_cache
 
@@ -175,7 +217,14 @@ class GSPMDTrainStep:
 
     def init_optimizer(self, params: Any) -> Any:
         state_shape = jax.eval_shape(self.optimizer.init, params)
-        shardings = optimizer_state_shardings(state_shape, params, self.mesh)
+        if self.plan is not None:
+            shardings = self.plan.optimizer_state_shardings(
+                state_shape, params
+            )
+        else:
+            shardings = optimizer_state_shardings(
+                state_shape, params, self.mesh
+            )
         return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
 
     def __call__(self, params: Any, opt_state: Any, batch: Any):
@@ -219,4 +268,19 @@ class GSPMDTrainStep:
         batch = jax.tree_util.tree_map(place, batch)
         if self._jitted is None:
             self._build(params, opt_state)
+        if self._step_rows:
+            # analytic-at-dispatch booking (the serve-engine idiom):
+            # XLA's ZeRO-2 updated-params all-gather never crosses the
+            # Python tracer, so each dispatch books the plan's closed
+            # form — a k-step comm audit equals k x price_step exactly
+            from ..obs.comm import record_collective
+
+            for r in self._step_rows:
+                record_collective(
+                    r["kind"],
+                    r["axis"],
+                    payload_bytes=r["payload_bytes"],
+                    count=r["count"],
+                    axis_size=r["axis_size"],
+                )
         return self._jitted(params, opt_state, batch)

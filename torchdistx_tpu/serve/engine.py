@@ -127,8 +127,15 @@ def _cache_sharding(
     mesh=None,
     tp_axis: str = "tp",
     kv_heads: Optional[int] = None,
+    plan=None,
 ):
     """Device placement for the slot/paged KV cache.
+
+    With a ``plan`` (a :class:`~torchdistx_tpu.parallel.plan.ShardingPlan`)
+    the pool layout comes from the plan's ``kv_cache`` pseudo-path rule
+    when one matches (``llama_tp_plan`` carries it), so the serve pool and
+    the training-side annotations are the same declarative object; the
+    ``kv_heads % tp`` divisibility assertion still gates below either way.
 
     With a ``mesh`` the policy is the **head-axis sharding**: every cache
     array is ``(num_slots | num_pages, rows, Hkv, D)``, and
@@ -167,7 +174,12 @@ def _cache_sharding(
                 "n_kv_heads (or a model with more KV heads) — an uneven "
                 "split would silently replicate the head axis"
             )
-        return NamedSharding(mesh, PartitionSpec(None, None, tp_axis, None))
+        spec = None
+        if plan is not None:
+            spec = plan.maybe_spec_for("kv_cache", (0, 0, kv_heads, 0))
+        if spec is None:
+            spec = PartitionSpec(None, None, tp_axis, None)
+        return NamedSharding(mesh, spec)
     for leaf in jax.tree_util.tree_leaves(params):
         sh = getattr(leaf, "sharding", None)
         if isinstance(sh, NamedSharding):
@@ -289,11 +301,13 @@ class ServeEngine:
         recorder naming the in-flight program and its cost card.  None
         (default) disables.
       mesh: a ``jax.sharding.Mesh`` to serve tensor-parallel over.  The
-        params are sharded with ``tp_rule`` (``parallel.tp.shard_params``
-        — a no-op for leaves already carrying the target sharding), the
-        KV slab/pools are sharded over the HEAD axis
-        (:func:`_cache_sharding` — ``P(None, None, tp_axis, None)``,
-        with ``n_kv_heads % tp`` asserted), page tables stay host-side,
+        params are placed by the declarative ``plan``
+        (``parallel.tp.shard_params`` applies its rule projection — a
+        no-op for leaves already carrying the target sharding), the
+        KV slab/pools are sharded by the plan's ``kv_cache`` rule
+        (:func:`_cache_sharding`, default ``P(None, None, tp_axis,
+        None)``, with ``n_kv_heads % tp`` asserted), page tables stay
+        host-side,
         and every compiled program becomes one SPMD program with
         explicit ``out_shardings`` on its donated KV carry and sampled
         outputs (jit does not propagate input shardings into fresh
@@ -305,9 +319,16 @@ class ServeEngine:
         per-shard bytes, so the HBM admission gate sees the ``1/tp``
         footprint that makes 7B+ models servable.  None (default): the
         single-device/replicated engine, unchanged.
-      tp_rule: parameter sharding rule ``(path, leaf) -> NamedSharding``
-        applied when ``mesh`` is given; default
-        ``parallel.tp.llama_tp_rule(mesh, tp_axis)``.
+      plan: the :class:`~torchdistx_tpu.parallel.plan.ShardingPlan`
+        that drives the mesh path — parameter placement AND the KV-pool
+        layout come from the one declarative object (the same plan a
+        ``Trainer`` / ``reshard_to_plan`` / fleet ``handoff_to`` would
+        hold).  Default when ``mesh`` is given:
+        ``parallel.tp.llama_tp_plan(mesh, tp_axis)``.
+      tp_rule: DEPRECATED — a bare parameter sharding rule ``(path,
+        leaf) -> NamedSharding``.  Kept as a shim (emits
+        ``DeprecationWarning``); pass ``plan=`` instead, which also
+        covers the KV pool, validation, and pricing.
       tp_axis: the mesh axis name to tensor-shard over (default
         ``"tp"``); other axes of the mesh are left replicated.
       chunked_prefill: prefill-chunk threshold in tokens.  A prompt (or
@@ -367,6 +388,7 @@ class ServeEngine:
         hbm_budget: Optional[int] = None,
         stall_timeout_s: Optional[float] = None,
         mesh: Optional[Any] = None,
+        plan: Optional[Any] = None,
         tp_rule: Optional[Any] = None,
         tp_axis: str = "tp",
         chunked_prefill: Optional[int] = None,
@@ -403,16 +425,45 @@ class ServeEngine:
                     f"{tuple(mesh.axis_names)}) — pass tp_axis="
                 )
             self.tp = int(mesh.shape[self.tp_axis])
-            from ..parallel.tp import llama_tp_rule, shard_params
+            from ..parallel.tp import llama_tp_plan, shard_params
 
-            if tp_rule is None:
-                tp_rule = llama_tp_rule(mesh, self.tp_axis)
-            self.params = shard_params(self.params, tp_rule)
+            if plan is not None and tp_rule is not None:
+                raise ValueError("pass plan= or tp_rule=, not both")
+            if tp_rule is not None:
+                # deprecation shim: a bare rule callable places params
+                # but cannot validate, price, or derive carry shardings
+                import warnings
+
+                warnings.warn(
+                    "ServeEngine(tp_rule=) is deprecated: pass the "
+                    "declarative plan instead — ServeEngine(plan="
+                    "llama_tp_plan(mesh, tp_axis)) or any ShardingPlan "
+                    "(parallel/plan.py)",
+                    DeprecationWarning,
+                    stacklevel=2,
+                )
+                rule = tp_rule
+            else:
+                if plan is None:
+                    plan = llama_tp_plan(mesh, self.tp_axis)
+                if plan.mesh is not mesh and tuple(
+                    plan.mesh.devices.flat
+                ) != tuple(mesh.devices.flat):
+                    raise ValueError(
+                        "plan.mesh does not cover the engine mesh — build "
+                        "the plan on the serving mesh (plan.with_mesh)"
+                    )
+                rule = plan.as_rule()
+            self.params = shard_params(self.params, rule)
         else:
             if tp_rule is not None:
                 raise ValueError("tp_rule requires mesh=")
+            if plan is not None:
+                raise ValueError("plan requires mesh=")
             self.tp = 1
-        self._tp_rule = tp_rule
+            rule = None
+        self.plan = plan
+        self._tp_rule = rule
         # closed-form comm accounting needs the block geometry; a model
         # whose config doesn't expose it serves fine, just unaudited
         _layers = getattr(cfg, "n_layers", None) or getattr(
@@ -505,6 +556,7 @@ class ServeEngine:
             mesh=mesh,
             tp_axis=self.tp_axis,
             kv_heads=None if _kv_heads is None else int(_kv_heads),
+            plan=self.plan,
         )
         from jax.sharding import NamedSharding, PartitionSpec
 

@@ -258,10 +258,13 @@ class Trainer:
         from .utils.failure import StepFailure
 
         old_mesh = getattr(self.step, "mesh", None)
-        if old_mesh is None or not hasattr(self.step, "param_sharding"):
+        old_plan = getattr(self.step, "plan", None)
+        if old_mesh is None or (
+            old_plan is None and not hasattr(self.step, "param_sharding")
+        ):
             raise StepFailure(
                 getattr(failure, "kind", "device_loss"),
-                f"{failure} (and the step carries no mesh to reshard)",
+                f"{failure} (and the step carries no mesh/plan to reshard)",
             )
         if mesh is None:
             mesh = self._shrunk_mesh(
@@ -277,15 +280,37 @@ class Trainer:
             mesh_to=mesh_to,
         )
         # fresh step object on the new mesh: _jitted resets, so the next
-        # call re-builds (and re-jits) with the new out_shardings
+        # call re-builds (and re-jits) with the new out_shardings.  A
+        # plan-carrying step keeps ONE source of sharding truth: the
+        # same rules over the shrunk mesh (plan.with_mesh), from which
+        # both param and optimizer-slot targets re-derive below.
+        new_plan = old_plan.with_mesh(mesh) if old_plan is not None else None
         if dataclasses.is_dataclass(self.step):
-            new_step = dataclasses.replace(self.step, mesh=mesh)
+            replace_kw = {"mesh": mesh}
+            if new_plan is not None and any(
+                f.name == "plan" for f in dataclasses.fields(self.step)
+            ):
+                replace_kw["plan"] = new_plan
+            new_step = dataclasses.replace(self.step, **replace_kw)
         else:
             new_step = copy.copy(self.step)
             new_step.mesh = mesh
+            if hasattr(new_step, "plan"):
+                new_step.plan = new_plan
             if hasattr(new_step, "_jitted"):
                 new_step._jitted = None
-        params_sh = new_step.param_sharding(self.params)
+        if new_plan is not None:
+            params_sh = new_plan.param_shardings(self.params)
+
+            def opt_shardings(opt_state, params):
+                return new_plan.optimizer_state_shardings(opt_state, params)
+
+        else:
+            params_sh = new_step.param_sharding(self.params)
+
+            def opt_shardings(opt_state, params):
+                return optimizer_state_shardings(opt_state, params, mesh)
+
         live = can_reshard_live(
             {"params": self.params, "opt_state": self.opt_state}, mesh
         )
@@ -293,9 +318,7 @@ class Trainer:
         with _audit(self.comm_profile), _audit(migration):
             if live:
                 self.params = _reshard(self.params, params_sh)
-                opt_sh = optimizer_state_shardings(
-                    self.opt_state, self.params, mesh
-                )
+                opt_sh = opt_shardings(self.opt_state, self.params)
                 self.opt_state = _reshard(self.opt_state, opt_sh)
             else:
                 base = os.path.join(
@@ -305,9 +328,7 @@ class Trainer:
                 self.params = reshard_via_checkpoint(
                     self.params, base + "_params", params_sh
                 )
-                opt_sh = optimizer_state_shardings(
-                    self.opt_state, self.params, mesh
-                )
+                opt_sh = opt_shardings(self.opt_state, self.params)
                 self.opt_state = reshard_via_checkpoint(
                     self.opt_state, base + "_opt", opt_sh
                 )
