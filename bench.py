@@ -103,20 +103,42 @@ def _train_throughput():
     # turns that from a timing inference into counters in the record:
     # warm-up compiles under "warmup", and the timed window's compiles
     # under "timed_window" (expected ZERO when warm_converged).
+    # under TDX_NUMERICS=1 the workload's aux is (losses, digests) — the
+    # digests ride the SAME scanned program (zero extra dispatches) and
+    # the record embeds the book below
+    num_on = bool(w.get("numerics"))
+
+    def _losses(aux):
+        return aux[0] if num_on else aux
+
     watcher = RecompileWatcher()
     carry, warm_times, warm_converged = warm_to_steady_state(
         run,
         carry,
-        sync=lambda losses: float(np.asarray(losses[-1])),
+        sync=lambda aux: float(np.asarray(_losses(aux)[-1])),
         watcher=watcher,
         label="warmup",
     )
 
     t0 = _time.perf_counter()
     with recompile_scope("timed_window"):
-        carry, losses = run(carry)
-        final_loss = float(np.asarray(losses[-1]))  # forces the whole chain
+        carry, aux = run(carry)
+        # forces the whole chain
+        final_loss = float(np.asarray(_losses(aux)[-1]))
     dt = _time.perf_counter() - t0
+
+    numerics_book = None
+    if num_on:
+        try:
+            import jax
+
+            from torchdistx_tpu.obs.numerics import NumericsBook
+
+            book = NumericsBook()
+            book.update_tree(jax.device_get(aux[1]))
+            numerics_book = book.to_json()
+        except Exception as e:  # telemetry must not kill the bench
+            numerics_book = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     toks = n_steps * w["batch"] * w["seq"]
     tokens_per_sec = toks / dt
@@ -191,6 +213,9 @@ def _train_throughput():
         "optimizer": w["optimizer"],
         "fused_ce": w["fused_ce"],
         "zero2": w["zero2"],
+        # digest book (tdx-numerics-v1) only under TDX_NUMERICS=1, so
+        # default-run records stay byte-stable
+        **({"numerics_book": numerics_book} if numerics_book else {}),
         # plan/byte fields only present on the zero2 arm
         **{
             k: w[k]

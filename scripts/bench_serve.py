@@ -261,6 +261,21 @@ def _parse_args():
         "--kv-dtype instead to run the WHOLE sweep quantized",
     )
     ap.add_argument(
+        "--numerics",
+        action="store_true",
+        help="append the numerics-observatory A/B phase (ISSUE 19): a "
+        "digest-off and a digest-on engine serve the SAME greedy "
+        "workload; STRICT on bit-identical streams and EXACTLY equal "
+        "host_syncs / decode_dispatches / decode_steps (digests fuse "
+        "into the existing programs and harvest at existing syncs — "
+        "enabling them must cost zero dispatches).  The on-leg embeds "
+        "the tdx-numerics-v1 digest book; its exact integer fields "
+        "land as ledger counter rows (workload keys 'numerics' + "
+        "'numerics_site') that perf_gate pins bit-identically across "
+        "runs.  Default phases never build digest engines, so "
+        "pre-existing fingerprints stay byte-stable",
+    )
+    ap.add_argument(
         "--artifact",
         default=None,
         help="override the BENCH_SERVE_<CPU|TPU>.json artifact path "
@@ -523,6 +538,16 @@ def _supervise(args) -> None:
                 {
                     "TDX_SERVE_CHUNK": str(chunks[-1]),
                     "TDX_SERVE_PHASE": "kv_quant",
+                },
+            )
+        )
+    if args.numerics:
+        plan.append(
+            (
+                "numerics",
+                {
+                    "TDX_SERVE_CHUNK": str(chunks[-1]),
+                    "TDX_SERVE_PHASE": "numerics",
                 },
             )
         )
@@ -915,6 +940,13 @@ def _dump_obs(record: dict, engine, tag: str) -> None:
     # the cost observatory's third export: the same cards the record
     # embeds, as tdx_cost_*{program=...} gauges on the exposition
     registry.register_collector(engine.cost_book.collector())
+    # numerics observatory: tdx_numerics_*{site=...} gauges — only
+    # digest engines register it, so default phases' expositions stay
+    # byte-stable; check_obs_artifacts --numerics cross-checks these
+    # samples against the embedded book exactly
+    book = getattr(engine, "numerics_book", None)
+    if getattr(engine, "numerics", False) and book is not None:
+        registry.register_collector(book.collector(), obj=book)
     prom_path = os.path.join(out_dir, f"{tag}_metrics.prom")
     with open(prom_path, "w") as f:
         f.write(registry.render())
@@ -1797,6 +1829,127 @@ def _child_kv_quant(args) -> None:
     print(json.dumps(record))
 
 
+def _child_numerics(args) -> None:
+    """The numerics-observatory A/B (ISSUE 19 tentpole evidence): one
+    digest-off engine and one digest-on engine serve the SAME greedy
+    workload, and the phase flags ``error`` unless the streams are
+    bit-identical AND every deterministic engine counter is EXACTLY
+    equal — digests fuse into the existing jitted programs as one extra
+    trailing output and harvest only at existing sync boundaries, so
+    enabling them must change neither ``host_syncs`` nor
+    ``decode_dispatches`` nor anything else countable.  The on-leg's
+    digest book (``tdx-numerics-v1``) is embedded whole; its integer
+    fields are reduction-order-invariant counts, so the ledger rows
+    they become gate bit-identically across runs in ``perf_gate
+    --strict``."""
+    record, name, k_chunk, plat = _phase_setup(
+        args, phase="numerics", numerics=True
+    )
+
+    import numpy as np
+
+    from torchdistx_tpu.serve import ServeEngine
+
+    try:
+        model = _build_model(name, plat)
+        limit = model.cfg.max_seq_len
+        max_len = args.max_len or min(limit, 8 * args.max_new)
+        n_req = max(2, min(args.requests, 2 * args.slots))
+        rs = np.random.RandomState(5)
+        max_prompt = max(1, min(max_len - args.max_new, max_len // 2))
+        work = [
+            dict(
+                prompt=rs.randint(0, 256, (int(n),)).astype(np.int32),
+                max_new_tokens=args.max_new,
+                temperature=0.0,  # the verdict is bit-identity
+            )
+            for n in rs.randint(1, max_prompt + 1, n_req)
+        ]
+        record["max_len"] = max_len
+
+        def build(numerics):
+            return ServeEngine(
+                model,
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                numerics=numerics,
+                **_mesh_kwargs(args),
+                **_kv_kwargs(args),
+            )
+
+        def measure(engine):
+            for _ in range(2):  # warm past the donated-carry recompile
+                engine.run([dict(w) for w in work])
+            engine.reset_metrics()
+            out = engine.run([dict(w) for w in work])
+            return [r.tokens for r in out]
+
+        off = build(False)
+        on = build(True)
+        off_tokens = measure(off)
+        on_tokens = measure(on)
+
+        m_off = off.metrics.to_json()
+        m_on = on.metrics.to_json()
+        record["metrics"] = m_on
+        record["metrics_baseline"] = m_off
+        book = on.numerics_book
+        record["numerics_book"] = book.to_json()
+        record["numerics_sites"] = book.sites()
+        _embed_cost(record, on)
+
+        identical = all(
+            np.array_equal(a, b) for a, b in zip(on_tokens, off_tokens)
+        )
+        c_off = m_off.get("counters") or {}
+        c_on = m_on.get("counters") or {}
+        unequal = {
+            k: (c_on.get(k), c_off.get(k))
+            for k in sorted(set(c_off) | set(c_on))
+            if c_on.get(k) != c_off.get(k)
+        }
+        bad_sites = [
+            s
+            for s, d in (record["numerics_book"].get("sites") or {}).items()
+            if d["count"]
+            != d["nonfinite"] + d["zeros"] + sum(d["exp_hist"])
+        ]
+        if not identical:
+            record["error"] = (
+                "enabling digests changed a sampled stream — taps must "
+                "be identities"
+            )
+        elif unequal:
+            record["error"] = (
+                "enabling digests moved engine counters (on vs off): "
+                f"{unequal}"
+            )
+        elif not book.sites():
+            record["error"] = (
+                "digest-on engine harvested no sites — is the tape "
+                "wired into the programs?"
+            )
+        elif book.digest("logits") is None:
+            record["error"] = (
+                f"no 'logits' digest (sites: {book.sites()})"
+            )
+        elif bad_sites:
+            record["error"] = (
+                "digest partition identity violated (count != nonfinite "
+                f"+ zeros + sum(exp_hist)) at: {bad_sites}"
+            )
+        elif book.first_nonfinite_site() is not None:
+            record["error"] = (
+                "healthy workload digested a nonfinite at "
+                f"{book.first_nonfinite_site()}"
+            )
+        _dump_obs(record, on, "numerics")
+    except Exception as e:  # degraded-but-parseable, bench.py contract
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
+
+
 def _slo_spec(args):
     """The committed ``--slo`` spec, parsed per use (cheap; children are
     one-shot processes).  None without the flag."""
@@ -2564,6 +2717,8 @@ def main() -> None:
             _child_migrate(args)
         elif phase == "kv_quant":
             _child_kv_quant(args)
+        elif phase == "numerics":
+            _child_numerics(args)
         elif phase == "fleet":
             _child_fleet(args)
         elif phase == "fleet_drain":

@@ -36,6 +36,16 @@ Usage:
     cards — numeric flops/bytes, peak source NAMED — and a bench.py
     record's ``extra.train_cost_card`` is checked the same way; the
     cost-observatory half of the nightly gate)
+  python scripts/check_obs_artifacts.py --numerics BENCH_SERVE_CPU.json
+    (numerics-observatory validation: every embedded ``tdx-numerics-v1``
+    digest book — a serve ``numerics`` A/B phase's or a bench.py train
+    phase's ``extra.numerics_book`` — must be schema-valid with the
+    exact partition identity ``count == nonfinite + zeros +
+    sum(exp_hist)`` intact per site, and the serve phase must carry its
+    zero-overhead evidence: digest-on engine counters EXACTLY equal to
+    the digest-off baseline's — plus, when the phase dumped an
+    exposition, tdx_numerics_*{site=} samples equal to the embedded
+    book's exact integer fields)
   python scripts/check_obs_artifacts.py --slo BENCH_SERVE_CPU_FLEET.json
     (SLO-observatory validation: every non-error fleet phase must embed
     a schema-valid ``tdx-slo-v1`` block — spec echoed, attainment in
@@ -234,6 +244,171 @@ def _check_ledger_main(paths: list) -> None:
             print(f"FAIL: {e}", file=sys.stderr)
         raise SystemExit(1)
     print(f"ledger OK ({len(paths)} file(s))")
+
+
+def _check_numerics(tag: str, book, errors: list) -> int:
+    """One embedded tdx-numerics-v1 digest book: schema, integer-typed
+    exact fields, the partition identity (``count == nonfinite + zeros
+    + sum(exp_hist)`` — exact by construction, so a violation means the
+    digest math itself broke), and the f64-exact ``hist_hash`` range.
+    Returns the number of sites checked."""
+    if not isinstance(book, dict):
+        errors.append(f"{tag}: numerics_book is not an object")
+        return 0
+    if "error" in book:
+        errors.append(f"{tag}: numerics_book errored: {book['error']}")
+        return 0
+    if book.get("schema") != "tdx-numerics-v1":
+        errors.append(
+            f"{tag}: numerics_book schema {book.get('schema')!r} != "
+            "'tdx-numerics-v1'"
+        )
+        return 0
+    sites = book.get("sites")
+    if not isinstance(sites, dict) or not sites:
+        errors.append(f"{tag}: numerics_book has no sites")
+        return 0
+    n = 0
+    for site, d in sorted(sites.items()):
+        n += 1
+        stag = f"{tag} site {site}"
+        ints = {k: d.get(k) for k in ("nonfinite", "zeros", "count",
+                                      "hist_hash")}
+        bad = [
+            k for k, v in ints.items()
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0
+        ]
+        hist = d.get("exp_hist")
+        if bad:
+            errors.append(f"{stag}: non-integer exact fields {bad}")
+            continue
+        if not (
+            isinstance(hist, list)
+            and hist
+            and all(isinstance(b, int) and b >= 0 for b in hist)
+        ):
+            errors.append(f"{stag}: exp_hist is not a list of counts")
+            continue
+        if ints["count"] != ints["nonfinite"] + ints["zeros"] + sum(hist):
+            errors.append(
+                f"{stag}: partition identity violated — count "
+                f"{ints['count']} != nonfinite {ints['nonfinite']} + "
+                f"zeros {ints['zeros']} + sum(exp_hist) {sum(hist)}"
+            )
+        if not 0 <= ints["hist_hash"] < 2**53:
+            errors.append(
+                f"{stag}: hist_hash {ints['hist_hash']} outside the "
+                "f64-exact range [0, 2**53)"
+            )
+        for k in ("max_abs", "rms"):
+            v = d.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errors.append(f"{stag}: gauge {k} is not numeric")
+    return n
+
+
+def _check_numerics_main(paths: list) -> None:
+    """Numerics-observatory validation: every embedded digest book must
+    be schema-valid with the partition identity intact per site, and a
+    serve ``numerics`` A/B phase must carry its zero-overhead evidence —
+    the on-leg's engine counters EXACTLY equal to the off-leg's
+    (``metrics`` vs ``metrics_baseline``), since digests ride existing
+    program outputs and harvest at existing syncs."""
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    checked_sites = 0
+    checked_books = 0
+    for path in paths:
+        with open(path) as f:
+            record = json.load(f)
+        phases = record.get("phases") or {}
+        books = []  # (tag, book, phase-or-None)
+        for name, phase in phases.items():
+            if isinstance(phase, dict) and "numerics_book" in phase:
+                books.append((f"{path} phase {name}", phase["numerics_book"],
+                              phase))
+        # bench.py records embed the train phase's book under extra
+        train_book = (record.get("extra") or {}).get("numerics_book")
+        if train_book is not None:
+            books.append((f"{path} train phase", train_book, None))
+        if not books:
+            errors.append(
+                f"{path}: no numerics_book anywhere — was the numerics "
+                "phase (bench_serve --numerics) or TDX_NUMERICS=1 "
+                "(bench.py) on for this run?"
+            )
+            continue
+        for tag, book, phase in books:
+            if phase is not None and "error" in phase:
+                errors.append(f"{tag}: {phase['error']}")
+                continue
+            checked_books += 1
+            checked_sites += _check_numerics(tag, book, errors)
+            if phase is None:
+                continue
+            c_on = (phase.get("metrics") or {}).get("counters") or {}
+            c_off = (
+                phase.get("metrics_baseline") or {}
+            ).get("counters") or {}
+            if not c_on or not c_off:
+                errors.append(
+                    f"{tag}: missing metrics/metrics_baseline counters — "
+                    "no zero-overhead evidence"
+                )
+            elif c_on != c_off:
+                unequal = {
+                    k: (c_on.get(k), c_off.get(k))
+                    for k in sorted(set(c_on) | set(c_off))
+                    if c_on.get(k) != c_off.get(k)
+                }
+                errors.append(
+                    f"{tag}: digest-on counters differ from digest-off: "
+                    f"{unequal}"
+                )
+            # exposition cross-check: the tdx_numerics_*{site=} gauges
+            # the phase rendered must equal the embedded book's exact
+            # integer fields — the exposition is a projection of
+            # to_json(), and this keeps the two surfaces from drifting
+            prom_path = phase.get("metrics_prom_path")
+            if prom_path and isinstance(book, dict):
+                try:
+                    with open(prom_path) as f:
+                        parsed = parse_prometheus(f.read())
+                except (OSError, ValueError) as e:
+                    errors.append(f"{tag}: numerics exposition: {e}")
+                    continue
+                samples = parsed["samples"]
+                for site, d in sorted((book.get("sites") or {}).items()):
+                    if not isinstance(d, dict):
+                        continue
+                    for field in ("nonfinite", "zeros", "count",
+                                  "hist_hash"):
+                        key = (
+                            f"tdx_numerics_{field}",
+                            (("site", site),),
+                        )
+                        if key not in samples:
+                            errors.append(
+                                f"{tag}: missing exposition sample "
+                                f"tdx_numerics_{field}{{site=\"{site}\"}}"
+                            )
+                        elif samples[key] != d.get(field):
+                            errors.append(
+                                f"{tag}: tdx_numerics_{field}"
+                                f"{{site=\"{site}\"}} is {samples[key]} "
+                                f"in exposition but {d.get(field)} in "
+                                "the embedded book — the projection "
+                                "drifted"
+                            )
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(
+        f"numerics OK ({checked_books} book(s), {checked_sites} site(s), "
+        "zero-overhead counters equal)"
+    )
 
 
 def _check_cost_main(paths: list) -> None:
@@ -561,6 +736,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--cost":
         _check_cost_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--numerics":
+        _check_numerics_main(sys.argv[2:])
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--slo":
         _check_slo_main(sys.argv[2:])

@@ -36,6 +36,12 @@ if not os.environ.get("TDX_FLIGHT_DIR"):
     os.environ["TDX_FLIGHT_DIR"] = tempfile.mkdtemp(prefix="tdx_flight_")
 FLIGHT_DIR = os.environ["TDX_FLIGHT_DIR"]
 
+# numerics observatory ON (ISSUE 19): the injected-NaN leg must name the
+# poisoned parameter in the failure/rollback flight records, which
+# requires the step's fused digests.  setdefault so an explicit
+# TDX_NUMERICS=0 run still exercises the plain crash path.
+os.environ.setdefault("TDX_NUMERICS", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -174,6 +180,36 @@ def main() -> None:
     else:
         n = check_flight(dump, errors, expect_rollback=True)
         print(f"crash dump {dump}: {n} records")
+        # ISSUE 19 provenance: the failure AND rollback records must name
+        # the injected site exactly — the digest engine saw the NaN in
+        # the poisoned parameter before anything downstream of it.
+        if os.environ.get("TDX_NUMERICS") not in ("0", "false", ""):
+            want_site = f"params/{k0}"
+            with open(dump) as f:
+                records = [
+                    json.loads(ln) for ln in f.read().splitlines()
+                    if ln.strip()
+                ]
+            for kind in ("failure", "rollback"):
+                rec = next(
+                    (r for r in records if r.get("kind") == kind), None
+                )
+                if rec is None:
+                    errors.append(f"numerics leg: no {kind!r} record")
+                elif rec.get("nonfinite_site") != want_site:
+                    errors.append(
+                        f"numerics provenance: {kind} record names "
+                        f"nonfinite_site={rec.get('nonfinite_site')!r}, "
+                        f"want {want_site!r}"
+                    )
+            book = trainer.numerics_book
+            if book.first_nonfinite_site() != want_site:
+                errors.append(
+                    f"numerics book names {book.first_nonfinite_site()!r},"
+                    f" want {want_site!r}"
+                )
+            else:
+                print(f"numerics provenance: {want_site} named in dump")
 
     stream = os.path.join(FLIGHT_DIR, f"flight_{os.getpid()}.jsonl")
     if not os.path.exists(stream):

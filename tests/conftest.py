@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 # monkeypatch, and an explicit TDX_COST_CARDS=1 run overrides this.
 os.environ.setdefault("TDX_COST_CARDS", "0")
 
+# numerics observatory (obs.numerics): OFF suite-wide for the same
+# reason — digest taps fuse extra reductions into every traced program.
+# tests/test_numerics.py opts in per test (engine kwarg / monkeypatch),
+# and an explicit TDX_NUMERICS=1 run overrides this.
+os.environ.setdefault("TDX_NUMERICS", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

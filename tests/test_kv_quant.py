@@ -337,6 +337,59 @@ class TestQuantizedEngine:
         np.testing.assert_array_equal(got.tokens, fresh.tokens)
 
 
+class TestKVQuantNumerics:
+    """ISSUE 19 satellite: the numerics observatory's KV dequant-error
+    digests feed ``kv_quant_err_max`` / ``kv_quant_err_rms`` gauges
+    (int8 pools only), and the observed max is pinned by the power-of-
+    two quantizer's round-to-nearest bound ``s/2``."""
+
+    def _run(self, **kw):
+        e = _engine(kv_dtype="int8", numerics=True, **kw)
+        e.run(
+            [
+                {"prompt": p, "max_new_tokens": 8, "temperature": 0.0}
+                for p in _prompts(11, (5, 9, 12))
+            ]
+        )
+        return e
+
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_err_max_pinned_by_half_scale(self, paged):
+        e = self._run(paged=paged)
+        book = e.numerics_book
+        err = book.digest("kv_quant_err")
+        scale = book.digest("kv_quant_scale")
+        assert err is not None and err.count > 0
+        assert err.nonfinite == 0
+        # round-to-nearest int8 against a power-of-two scale: every
+        # dequant error is <= s/2 with s the LARGEST scale the write
+        # sites produced (max_abs of the scale digest) — tiny float
+        # headroom only for the digest's own f32 max reduction
+        bound = 0.5 * scale.max_abs
+        assert err.max_abs <= bound * (1 + 1e-6), (err.max_abs, bound)
+        g = e.metrics.to_json()["gauges"]
+        assert g["kv_quant_err_max"] == err.max_abs
+        assert g["kv_quant_err_max"] <= bound * (1 + 1e-6)
+        assert 0 < g["kv_quant_err_rms"] <= g["kv_quant_err_max"]
+
+    def test_gauges_survive_reset_metrics(self):
+        e = self._run()
+        g = e.metrics.to_json()["gauges"]
+        e.reset_metrics()
+        g2 = e.metrics.to_json()["gauges"]
+        assert g2["kv_quant_err_max"] == g["kv_quant_err_max"]
+        assert g2["kv_quant_err_rms"] == g["kv_quant_err_rms"]
+
+    def test_gauges_int8_pools_only(self):
+        # plain bf16/f32 caches have no quantizer, hence no error gauge
+        # family — even with the observatory on
+        e = _engine(numerics=True)
+        e.run([{"prompt": _prompts(11, (5,))[0], "max_new_tokens": 4}])
+        g = e.metrics.to_json()["gauges"]
+        assert "kv_quant_err_max" not in g
+        assert "kv_quant_err_rms" not in g
+
+
 class TestQuantizedMoves:
     def _reqs(self):
         prompts = _prompts(7, (6, 9, 5, 11))

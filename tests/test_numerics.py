@@ -1,0 +1,420 @@
+"""Numerics observatory (obs/numerics.py, ISSUE 19).
+
+The load-bearing invariants, pinned on the 8-device CPU mesh:
+
+- **Exact partition**: every f32 element lands in exactly one class —
+  ``count == nonfinite + zeros + sum(exp_hist)`` — because the digest
+  classifies the int32 BIT PATTERN (bitcast), never float predicates
+  (XLA CPU flushes subnormals inconsistently between fusions).
+- **Reduction-order invariance**: the integer fields are pure counts, so
+  they are bit-identical across runs, across eager-vs-deferred paths,
+  and across MESH SHAPES (fsdp 8 vs 2x4) — the determinism class the
+  drift gate pins.  ``max_abs``/``rms`` are only per-platform stable.
+- **Zero observability cost at the dispatch level**: digests fuse into
+  the EXISTING jitted programs and ride their outputs; enabling them
+  changes neither ``host_syncs`` nor ``decode_dispatches`` nor the
+  sampled streams (pinned against the serve counters below).
+- **Provenance**: the earliest tap site (program order: params ->
+  activations -> loss -> grads) whose nonfinite count goes positive is
+  named exactly — the crash-path contract
+  ``scripts/crash_injection_smoke.py`` enforces end-to-end.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu import nn
+from torchdistx_tpu.models import Llama
+from torchdistx_tpu.obs.numerics import (
+    NBUCKETS,
+    HostDigest,
+    NumericsBook,
+    array_digest,
+    merge_digests,
+    numerics_tape,
+    provenance_key,
+    tap,
+    tap_error,
+    tree_digest,
+    zero_digest,
+)
+from torchdistx_tpu.parallel import GSPMDTrainStep, ShardedTrainStep
+from torchdistx_tpu.serve import ServeEngine
+
+
+def _host(d) -> HostDigest:
+    return HostDigest.from_device(jax.device_get(d))
+
+
+def _messy(seed, n=4096):
+    """An array exercising every digest class: normals across many
+    exponent decades, exact zeros, subnormals, NaNs, and both infs."""
+    rs = np.random.RandomState(seed)
+    x = (rs.randn(n) * np.exp2(rs.randint(-40, 40, n))).astype(np.float32)
+    x[::97] = 0.0
+    x[1::97] = 1e-42  # subnormal
+    x[2::197] = np.nan
+    x[3::197] = np.inf
+    x[4::197] = -np.inf
+    return x
+
+
+class TestDigestExactness:
+    def test_identity_partitions_every_element(self):
+        x = _messy(0)
+        d = _host(array_digest(jnp.asarray(x)))
+        assert d.count == x.size
+        assert d.nonfinite + d.zeros + sum(d.exp_hist) == d.count
+        assert d.nonfinite == int(np.sum(~np.isfinite(x)))
+        # zeros by BIT pattern: exactly +-0 — subnormals are NOT zeros
+        # even where XLA's float compares would flush them
+        assert d.zeros == int(np.sum(x == 0.0))
+        assert len(d.exp_hist) == NBUCKETS
+
+    def test_merge_matches_whole_array_digest(self):
+        x = _messy(1)
+        a = _host(array_digest(jnp.asarray(x[:1000])))
+        b = _host(array_digest(jnp.asarray(x[1000:])))
+        whole = _host(array_digest(jnp.asarray(x)))
+        merged = a.merge(b)
+        assert merged == whole  # exact-field equality
+        assert merged.hist_hash == whole.hist_hash
+        # merge is commutative in every field (max/sum reductions)
+        assert b.merge(a) == merged
+        assert b.merge(a).max_abs == merged.max_abs
+
+    def test_device_merge_matches_host_merge(self):
+        x, y = _messy(2), _messy(3)
+        dev = _host(
+            merge_digests(
+                array_digest(jnp.asarray(x)), array_digest(jnp.asarray(y))
+            )
+        )
+        host = _host(array_digest(jnp.asarray(x))).merge(
+            _host(array_digest(jnp.asarray(y)))
+        )
+        assert dev == host and dev.max_abs == host.max_abs
+
+    def test_zero_digest_is_merge_identity(self):
+        d = _host(array_digest(jnp.asarray(_messy(4))))
+        z = _host(zero_digest())
+        assert z.count == 0 and z.hist_hash == z.hist_hash  # stable
+        assert z.merge(d) == d and d.merge(z) == d
+
+    def test_two_runs_bit_identical(self):
+        # the determinism class the drift gate pins: same data, separate
+        # dispatches -> the ENTIRE digest matches, hist_hash included
+        x = jnp.asarray(_messy(5))
+        d1, d2 = _host(array_digest(x)), _host(array_digest(x))
+        assert d1 == d2
+        assert d1.hist_hash == d2.hist_hash
+        assert d1.max_abs == d2.max_abs and d1.sumsq == d2.sumsq
+
+    def test_json_roundtrip_preserves_exact_fields(self):
+        d = _host(array_digest(jnp.asarray(_messy(6))))
+        j = json.loads(json.dumps(d.to_json()))
+        book = NumericsBook()
+        book.update("s", d)
+        back = NumericsBook.from_json(
+            json.loads(json.dumps(book.to_json()))
+        ).digest("s")
+        assert back == d
+        assert j["hist_hash"] == d.hist_hash
+
+
+class TestTape:
+    def test_tap_is_identity_and_records(self):
+        x = jnp.asarray(_messy(7))
+        with numerics_tape() as tape:
+            y = tap("site", x)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        assert _host(tape.digests()["site"]).count == x.size
+
+    def test_tap_without_tape_is_free_identity(self):
+        x = jnp.ones((4,))
+        assert tap("nobody", x) is x
+
+    def test_declared_sites_preseed_zero(self):
+        # static carry structure for scan/while bodies: every declared
+        # site exists even when nothing tapped it this trace
+        with numerics_tape(sites=("a", "b")) as tape:
+            tap("a", jnp.ones((3,)))
+        digs = tape.digests()
+        assert set(digs) == {"a", "b"}
+        assert _host(digs["b"]).count == 0
+
+    def test_non_inexact_dtypes_skipped(self):
+        with numerics_tape() as tape:
+            tap("ints", jnp.arange(5, dtype=jnp.int32))
+        assert "ints" not in tape.digests()
+
+    def test_tap_error_digests_the_difference(self):
+        x = jnp.asarray([1.0, 2.0, 3.0])
+        with numerics_tape() as tape:
+            tap_error("err", x, x + 0.5)
+        d = _host(tape.digests()["err"])
+        assert d.count == 3 and abs(d.max_abs - 0.5) < 1e-7
+
+    def test_provenance_order(self):
+        sites = ["grads/w", "loss", "act/block10", "act/block2", "params/w"]
+        assert sorted(sites, key=provenance_key) == [
+            "params/w", "act/block2", "act/block10", "loss", "grads/w",
+        ]
+
+
+class _MLPParams:
+    """Raw-dict two-layer MLP with an activation tap — exercises the
+    tape inside shard_map (fsdp) and plain jit (gspmd) identically."""
+
+    @staticmethod
+    def init(seed=0):
+        rs = np.random.RandomState(seed)
+        return {
+            "w1": jnp.asarray(rs.randn(16, 32) * 0.1, jnp.float32),
+            "b1": jnp.zeros((32,), jnp.float32),
+            "w2": jnp.asarray(rs.randn(32, 16) * 0.1, jnp.float32),
+            "b2": jnp.zeros((16,), jnp.float32),
+        }
+
+    @staticmethod
+    def loss_fn(p, batch):
+        x, y = batch
+        h = tap("hidden", jax.nn.relu(x @ p["w1"] + p["b1"]))
+        return jnp.mean((h @ p["w2"] + p["b2"] - y) ** 2)
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    b = rs.randn(8, 16).astype(np.float32)
+    return (jnp.asarray(b), jnp.asarray(b))
+
+
+def _fsdp_book(mesh8, poison=None, steps=2):
+    params = _MLPParams.init()
+    if poison:
+        params[poison] = params[poison] * jnp.float32(np.nan)
+    step = ShardedTrainStep(
+        _MLPParams.loss_fn, optax.sgd(1e-2), mesh8,
+        shard_axis="fsdp", numerics=True,
+    )
+    p = step.shard_params(params)
+    s = step.init_optimizer(p)
+    book = NumericsBook()
+    for i in range(steps):
+        p, s, _ = step(p, s, _batch(i))
+        book.update_tree(jax.device_get(step.last_digests), step=i)
+    return book
+
+
+def _gspmd_book(mesh, steps=2):
+    params = jax.device_put(
+        _MLPParams.init(), NamedSharding(mesh, P())
+    )
+    step = GSPMDTrainStep(
+        _MLPParams.loss_fn, optax.sgd(1e-2), mesh, numerics=True
+    )
+    s = step.init_optimizer(params)
+    book = NumericsBook()
+    for i in range(steps):
+        params, s, _ = step(params, s, _batch(i))
+        book.update_tree(jax.device_get(step.last_digests), step=i)
+    return book
+
+
+class TestTrainStepDigests:
+    def test_two_runs_bit_identical(self, mesh8):
+        b1, b2 = _fsdp_book(mesh8), _fsdp_book(mesh8)
+        assert b1.sites() == b2.sites()
+        for site in b1.sites():
+            d1, d2 = b1.digest(site), b2.digest(site)
+            assert d1 == d2, site
+            assert d1.hist_hash == d2.hist_hash, site
+            # same platform, same program: the gauge class agrees too
+            assert d1.max_abs == d2.max_abs, site
+
+    def test_cross_mesh_integer_fields_bit_identical(self, mesh8, mesh2x4):
+        """fsdp-8 (shard_map, batch sharded 8-way, digests psum'd) vs a
+        2x4 GSPMD mesh (global-array digests): the INTEGER fields count
+        each element exactly once either way, so they match bit for bit
+        — including the full exponent histogram via hist_hash."""
+        bf, bg = _fsdp_book(mesh8), _gspmd_book(mesh2x4)
+        assert set(bf.sites()) == set(bg.sites())
+        assert "act/hidden" in bf.sites() and "loss" in bf.sites()
+        for site in bf.sites():
+            df, dg = bf.digest(site), bg.digest(site)
+            assert df.int_fields() == dg.int_fields(), site
+            assert df.hist_hash == dg.hist_hash, site
+
+    def test_nonfinite_provenance_names_earliest_site(self, mesh8):
+        book = _fsdp_book(mesh8, poison="w1", steps=1)
+        # the poisoned PARAMETER precedes everything it contaminates
+        # (act/hidden, loss, grads) in program order
+        assert book.first_nonfinite_site() == "params/w1"
+        assert book.first_nonfinite_step == 0
+        assert book.digest("params/w1").nonfinite > 0
+
+    def test_off_by_default_no_digest_output(self, mesh8):
+        step = ShardedTrainStep(
+            _MLPParams.loss_fn, optax.sgd(1e-2), mesh8, shard_axis="fsdp"
+        )
+        p = step.shard_params(_MLPParams.init())
+        s = step.init_optimizer(p)
+        step(p, s, _batch())
+        assert step.last_digests is None
+
+
+def _serve_run(numerics, **kw):
+    tdx.manual_seed(0)
+    model = Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+    eng = ServeEngine(
+        model, num_slots=3, max_len=64, prefill_buckets=(16,),
+        numerics=numerics, **kw,
+    )
+    rs = np.random.RandomState(5)
+    res = eng.run(
+        [
+            {
+                "prompt": rs.randint(0, 256, (n,)).astype(np.int32),
+                "max_new_tokens": 8,
+                "temperature": 0.0,
+            }
+            for n in (5, 9, 12)
+        ]
+    )
+    return eng, [tuple(r.tokens) for r in res]
+
+
+class TestServeDigests:
+    @pytest.mark.parametrize(
+        "mode",
+        [{}, {"decode_mode": "persistent"}, {"speculate": 2}],
+        ids=["chunked", "persistent", "spec"],
+    )
+    def test_zero_extra_syncs_and_identical_streams(self, mode):
+        """THE overhead pin: enabling digests adds ZERO host syncs and
+        ZERO dispatches — the digest dict rides existing program outputs
+        and is harvested at existing sync points — and the sampled
+        streams are bit-identical (taps are identities)."""
+        e_off, s_off = _serve_run(False, **mode)
+        e_on, s_on = _serve_run(True, **mode)
+        assert s_on == s_off
+        c_off = e_off.metrics.to_json()["counters"]
+        c_on = e_on.metrics.to_json()["counters"]
+        for key in ("host_syncs", "decode_dispatches", "decode_steps",
+                    "prefill_calls"):
+            assert c_on[key] == c_off[key], key
+        assert e_on.numerics_book.digest("logits").count > 0
+        assert e_off.numerics_book.sites() == []
+
+    def test_two_runs_bit_identical(self):
+        e1, _ = _serve_run(True)
+        e2, _ = _serve_run(True)
+        assert e1.numerics_book.sites() == e2.numerics_book.sites()
+        for site in e1.numerics_book.sites():
+            d1 = e1.numerics_book.digest(site)
+            d2 = e2.numerics_book.digest(site)
+            assert d1 == d2 and d1.hist_hash == d2.hist_hash, site
+
+    def test_numerics_joins_static_key(self):
+        e_on, _ = _serve_run(True)
+        e_off, _ = _serve_run(False)
+        assert e_on._static_key() != e_off._static_key()
+
+
+class _MLPModule(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 16)
+
+    def forward(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+class TestReplayDigests:
+    def _materialize_chunked(self, monkeypatch, numerics):
+        monkeypatch.setenv("TDX_NUMERICS", "1" if numerics else "0")
+        tdx.manual_seed(0)
+        m = tdx.deferred_init(_MLPModule)
+        sess = next(iter(dict(m.named_parameters()).values()))._session
+        sess.replay_mode = "chunked"
+        sess.chunk_size = 4
+        tdx.materialize_module(m)
+        return m, sess
+
+    def test_chunk_digests_and_deferred_vs_eager(self, monkeypatch):
+        m, sess = self._materialize_chunked(monkeypatch, True)
+        book = sess.numerics_book
+        assert book is not None and book.sites() == ["replay/chunk"]
+        d = book.digest("replay/chunk")
+        assert d.count > 0 and d.nonfinite == 0
+        # deferred-init-equals-eager-init, restated as DIGEST equality:
+        # same rng counter stream => bit-identical params => equal
+        # digests per parameter site (the observatory's own statement of
+        # the repo's core invariant)
+        tdx.manual_seed(0)
+        eager = _MLPModule()
+        td = tree_digest(dict(m.named_parameters()), prefix="params")
+        te = tree_digest(dict(eager.named_parameters()), prefix="params")
+        assert set(td) == set(te)
+        for k in td:
+            assert _host(td[k]) == _host(te[k]), k
+
+    def test_two_sessions_bit_identical(self, monkeypatch):
+        _, s1 = self._materialize_chunked(monkeypatch, True)
+        _, s2 = self._materialize_chunked(monkeypatch, True)
+        d1 = s1.numerics_book.digest("replay/chunk")
+        d2 = s2.numerics_book.digest("replay/chunk")
+        assert d1 == d2 and d1.hist_hash == d2.hist_hash
+
+    def test_off_leaves_no_book(self, monkeypatch):
+        _, sess = self._materialize_chunked(monkeypatch, False)
+        assert sess.numerics_book is None
+
+
+class TestBookExports:
+    def _book(self):
+        book = NumericsBook()
+        book.update("act/a", _host(array_digest(jnp.asarray(_messy(8)))))
+        book.update("loss", _host(array_digest(jnp.ones((4,)))))
+        return book
+
+    def test_counter_rows_are_exact_ints(self):
+        rows = self._book().counter_rows()
+        sites = {r["site"] for r in rows}
+        assert sites == {"act/a", "loss"}
+        for r in rows:
+            assert r["metric"].startswith("numerics_")
+            assert float(r["value"]) == int(r["value"])  # f64-exact
+
+    def test_drift_rows_flag_only_changed_fields(self):
+        book = self._book()
+        pins = {s: book.digest(s).int_fields() for s in book.sites()}
+        assert book.drift_rows(pins) == []
+        pins["loss"]["zeros"] += 1
+        drifted = book.drift_rows(pins)
+        assert drifted == [
+            {"site": "loss", "metric": "zeros",
+             "expected": pins["loss"]["zeros"],
+             "actual": pins["loss"]["zeros"] - 1}
+        ]
+        pins2 = {"never/tapped": {"count": 1}}
+        assert book.drift_rows(pins2)[0]["metric"] == "missing"
+
+    def test_collector_emits_site_labelled_gauges(self):
+        from torchdistx_tpu.obs.metrics import render_prometheus
+
+        book = self._book()
+        fams = book.collector()()
+        names = {f.name for f in fams}
+        assert any(n.startswith("tdx_numerics_") for n in names)
+        rendered = render_prometheus(fams)
+        assert 'site="act/a"' in rendered
+        assert "tdx_numerics_nonfinite" in rendered

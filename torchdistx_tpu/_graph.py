@@ -265,6 +265,15 @@ class RecordingSession:
         # observability: compiles vs dispatches (survive cache clearing)
         self.chunk_compiles = 0
         self.chunk_dispatches = 0
+        # numerics observatory (obs.numerics, TDX_NUMERICS): each chunk
+        # dispatch carries ONE fused digest of its inexact outputs as an
+        # extra program output; digests park here and fold into the book
+        # lazily at the end of the chunked replay (the arrays are this
+        # replay's own outputs — fetching them adds no dispatch).  The
+        # book is created on first harvest so a numerics-off session
+        # pays nothing, not even the import.
+        self.numerics_book: Any = None
+        self._pending_chunk_digests: list = []
         # unhashable static-leaf tokens for _eager_compile_sig: id -> a
         # (monotonic token, held ref) pair (see leaf_sig)
         self._static_sig_tokens: dict[int, tuple] = {}
@@ -606,6 +615,23 @@ class RecordingSession:
         """
         for a, b in self._schedule_bounds(sched):
             self._run_chunk(sched[a:b], env, emit, ambient)
+        self._harvest_chunk_digests()
+
+    def _harvest_chunk_digests(self) -> None:
+        """Fold every parked per-chunk digest into the session's
+        :class:`~torchdistx_tpu.obs.numerics.NumericsBook` under the
+        ``replay/chunk`` site.  Called once per chunked replay, AFTER
+        all chunks dispatched — the digests are outputs of dispatches
+        the replay already made, so this is a fetch, never a new one."""
+        if not self._pending_chunk_digests:
+            return
+        pend, self._pending_chunk_digests = self._pending_chunk_digests, []
+        from .obs.numerics import NumericsBook
+
+        if self.numerics_book is None:
+            self.numerics_book = NumericsBook()
+        for d in jax.device_get(pend):
+            self.numerics_book.update_tree({"replay/chunk": d})
 
     def _run_chunk(self, chunk, env, emit, ambient) -> None:
         closures = [self.closures[n] for n in chunk]
@@ -670,10 +696,18 @@ class RecordingSession:
             sig_parts.append(tuple(_freeze(s) for s in acc))
 
         ext_vals = [env[k] for k in ext_keys]
+        # numerics flag joins the signature: a digest-carrying chunk
+        # program has one extra output and must never share an
+        # executable with the plain one (toggling TDX_NUMERICS between
+        # replays retraces rather than mis-unpacks)
+        from .obs.numerics import numerics_enabled
+
+        num_on = numerics_enabled()
         sig = (
             tuple(sig_parts),
             tuple((tuple(v.shape), str(v.dtype)) for v in ext_vals),
             tuple(sorted(tls_list[0].items())) if tls_list[0] else None,
+            num_on,
         )
 
         self.chunk_dispatches += 1
@@ -707,6 +741,23 @@ class RecordingSession:
                 flat: list[Any] = []
                 for outs in local:
                     flat.extend(outs)
+                if num_on:
+                    # one fused digest over the chunk's inexact outputs
+                    # — traced into the SAME executable, one extra
+                    # output, zero extra dispatches
+                    from .obs.numerics import (
+                        array_digest,
+                        merge_digests,
+                        zero_digest,
+                    )
+
+                    d = zero_digest()
+                    for x in flat:
+                        if hasattr(x, "dtype") and jnp.issubdtype(
+                            x.dtype, jnp.inexact
+                        ):
+                            d = merge_digests(d, array_digest(x))
+                    return flat, d
                 return flat
 
             entry = jax.jit(chunk_fn)
@@ -743,6 +794,9 @@ class RecordingSession:
             "replay/chunk", cat="replay", ops=len(chunk)
         ), recompile_scope("replay/chunk"):
             flat = entry(ext_vals, dyn_vals)
+        if num_on:
+            flat, dig = flat
+            self._pending_chunk_digests.append(dig)
         pos = 0
         for nid, c in zip(chunk, closures):
             emit(nid, flat[pos : pos + c.n_outputs])

@@ -18,8 +18,26 @@ import jax
 import jax.numpy as jnp
 
 from .nn.module import functional_call
+from .obs.numerics import (
+    merge_digest_trees,
+    numerics_tape,
+    tap,
+    zero_digest,
+)
 
 __all__ = ["generate", "generate_encdec"]
+
+#: the serve programs' declared numerics tap sites (obs.numerics).  The
+#: tape inside a scan/while body must declare its sites up front so the
+#: digest accumulator can ride the loop carry with a static structure;
+#: these three cover everything the decode bodies can observe — the
+#: sampled-position logits plus the quantized caches' per-write
+#: dequantization error and scale (serve/kv_cache.py ``_tap_quant``).
+_NUMERICS_SITES = ("logits", "kv_quant_err", "kv_quant_scale")
+
+
+def _zero_site_digests():
+    return {s: zero_digest() for s in _NUMERICS_SITES}
 
 
 def _apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
@@ -131,7 +149,7 @@ def _make_decode_body(
             model, params, (tok[:, None], kv, pos) + extra,
             method="forward_decode",
         )
-        sampled = sampler(logits[:, -1, :], temps, seeds, stp)
+        sampled = sampler(tap("logits", logits[:, -1, :]), temps, seeds, stp)
         new_tok = jnp.where(fin, tok, sampled)
         new_stp = jnp.where(fin, stp, stp + 1)
         hit_eos = (
@@ -158,6 +176,7 @@ def _make_fused_decode(
     eos_token: Optional[int],
     max_len: int,
     decode_chunk: int,
+    numerics: bool = False,
 ):
     """Build the serve engine's fused K-step decode program body: a
     ``lax.scan`` of ``decode_chunk`` single-token ``forward_decode`` +
@@ -194,6 +213,12 @@ def _make_fused_decode(
     page-aligned footprint is allocated at admission, so no chunk ever
     needs a page the table doesn't already name) and forwarded to
     ``forward_decode`` each step.
+
+    With ``numerics=True`` (the engine's numerics observatory) each scan
+    step runs under a declared-site tape and the merged
+    ``{site: digest}`` dict rides the carry, returned as one extra
+    trailing output — same dispatch, same sync, one more (tiny) fetched
+    leaf.  ``numerics=False`` traces the exact pre-observatory program.
     """
 
     step = _make_decode_body(
@@ -202,15 +227,28 @@ def _make_fused_decode(
 
     def run(params, kv, toks, positions, temps, seeds, steps, budgets,
             finished, *extra):
-        def body(carry, _):
-            carry = step(params, temps, seeds, budgets, extra, carry)
-            return carry, carry[1]  # emit new_tok
+        init = (kv, toks, positions, steps, finished)
+        if not numerics:
+            def body(carry, _):
+                carry = step(params, temps, seeds, budgets, extra, carry)
+                return carry, carry[1]  # emit new_tok
 
-        (kv, _, _, _, _), toks_block = jax.lax.scan(
-            body, (kv, toks, positions, steps, finished), None,
-            length=decode_chunk,
+            (kv, _, _, _, _), toks_block = jax.lax.scan(
+                body, init, None, length=decode_chunk
+            )
+            return kv, toks_block
+
+        def body(carry, _):
+            inner, digs = carry
+            with numerics_tape(sites=_NUMERICS_SITES) as tape:
+                inner = step(params, temps, seeds, budgets, extra, inner)
+            digs = merge_digest_trees(digs, tape.digests())
+            return (inner, digs), inner[1]  # emit new_tok
+
+        (inner, digs), toks_block = jax.lax.scan(
+            body, (init, _zero_site_digests()), None, length=decode_chunk
         )
-        return kv, toks_block
+        return inner[0], toks_block, digs
 
     return run
 
@@ -223,6 +261,7 @@ def _make_persistent_decode(
     max_len: int,
     ring_capacity: int,
     stream_cb=None,
+    numerics: bool = False,
 ):
     """Build the serve engine's PERSISTENT decode program: the fused
     body (``_make_decode_body`` — the same function the K-step scan
@@ -262,7 +301,9 @@ def _make_persistent_decode(
     authoritative token path whether or not the stream fires.
 
     Returns ``run(params, kv, toks, positions, temps, seeds, steps,
-    budgets, active, *extra) -> (kv, ring, valid, iterations)``.
+    budgets, active, *extra) -> (kv, ring, valid, iterations)``, plus a
+    trailing merged ``{site: digest}`` dict when ``numerics=True`` (the
+    accumulator rides the loop carry — the drain stays the one sync).
     """
 
     step = _make_decode_body(
@@ -278,27 +319,38 @@ def _make_persistent_decode(
         valid0 = jnp.zeros((ring_capacity, toks.shape[0]), bool)
 
         def cond(carry):
-            (_, _, _, _, fin), _, _, it = carry
-            return jnp.logical_and(~jnp.all(fin), it < ring_capacity)
+            # carry[0][4] is the finish mask, carry[3] the cursor — the
+            # same positions with or without the trailing digest dict
+            return jnp.logical_and(
+                ~jnp.all(carry[0][4]), carry[3] < ring_capacity
+            )
 
         def body(carry):
-            inner, ring, valid, it = carry
+            inner, ring, valid, it = carry[:4]
             live = ~inner[4]  # sampled-this-iteration rows
-            inner = step(params, temps, seeds, budgets, extra, inner)
+            if numerics:
+                with numerics_tape(sites=_NUMERICS_SITES) as tape:
+                    inner = step(params, temps, seeds, budgets, extra, inner)
+                digs = merge_digest_trees(carry[4], tape.digests())
+            else:
+                inner = step(params, temps, seeds, budgets, extra, inner)
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, inner[1], it, 0
             )
             valid = jax.lax.dynamic_update_index_in_dim(valid, live, it, 0)
             if stream_cb is not None:
                 stream_cb(inner[1], live, it)
-            return (inner, ring, valid, it + 1)
+            out = (inner, ring, valid, it + 1)
+            return out + ((digs,) if numerics else ())
 
-        (kv, _, _, _, _), ring, valid, it = jax.lax.while_loop(
-            cond,
-            body,
-            ((kv, toks, positions, steps, fin0), ring0, valid0,
-             jnp.int32(0)),
-        )
+        init = ((kv, toks, positions, steps, fin0), ring0, valid0,
+                jnp.int32(0))
+        if numerics:
+            init = init + (_zero_site_digests(),)
+        res = jax.lax.while_loop(cond, body, init)
+        (kv, _, _, _, _), ring, valid, it = res[:4]
+        if numerics:
+            return kv, ring, valid, it, res[4]
         return kv, ring, valid, it
 
     return run
@@ -411,6 +463,7 @@ def _make_spec_decode_body(
         logits, kv = functional_call(
             model, params, (qtok, kv, pos) + extra, method="forward_decode"
         )
+        logits = tap("logits", logits)  # the whole (B, K+1) verify block
         y1 = sampler(logits[:, 0, :], temps, seeds, stp)
         gre = jnp.argmax(logits, axis=-1).astype(tok.dtype)
         y_block = jnp.concatenate([y1[:, None], gre[:, 1:]], axis=1)
@@ -467,6 +520,7 @@ def _make_fused_spec_decode(
     decode_chunk: int,
     speculate: int,
     ngram: int = 2,
+    numerics: bool = False,
 ):
     """Fused K-iteration speculative decode: ``_make_spec_decode_body``
     under a ``decode_chunk``-length ``lax.scan``.  Each scan step emits
@@ -490,17 +544,32 @@ def _make_fused_spec_decode(
 
     def run(params, kv, toks, positions, hist, temps, seeds, steps,
             budgets, finished, *extra):
-        def body(carry, _):
-            carry, y_block, cnt = step(
-                params, temps, seeds, budgets, extra, carry
-            )
-            return carry, (y_block, cnt)
+        init = (kv, toks, positions, steps, finished, hist)
+        if not numerics:
+            def body(carry, _):
+                carry, y_block, cnt = step(
+                    params, temps, seeds, budgets, extra, carry
+                )
+                return carry, (y_block, cnt)
 
-        (kv, _, _, _, _, _), (ys, cs) = jax.lax.scan(
-            body, (kv, toks, positions, steps, finished, hist), None,
-            length=decode_chunk,
+            (kv, _, _, _, _, _), (ys, cs) = jax.lax.scan(
+                body, init, None, length=decode_chunk
+            )
+            return kv, ys, cs
+
+        def body(carry, _):
+            inner, digs = carry
+            with numerics_tape(sites=_NUMERICS_SITES) as tape:
+                inner, y_block, cnt = step(
+                    params, temps, seeds, budgets, extra, inner
+                )
+            digs = merge_digest_trees(digs, tape.digests())
+            return (inner, digs), (y_block, cnt)
+
+        (inner, digs), (ys, cs) = jax.lax.scan(
+            body, (init, _zero_site_digests()), None, length=decode_chunk
         )
-        return kv, ys, cs
+        return inner[0], ys, cs, digs
 
     return run
 
@@ -514,6 +583,7 @@ def _make_persistent_spec_decode(
     ring_capacity: int,
     speculate: int,
     ngram: int = 2,
+    numerics: bool = False,
 ):
     """Persistent speculative decode: the SAME ``_make_spec_decode_body``
     under the ``lax.while_loop`` fixpoint drive of
@@ -549,24 +619,37 @@ def _make_persistent_spec_decode(
         cnt0 = jnp.zeros((ring_capacity, b), jnp.int32)
 
         def cond(carry):
-            (_, _, _, _, fin, _), _, _, it = carry
-            return jnp.logical_and(~jnp.all(fin), it < ring_capacity)
+            # carry[0][4] is the finish mask, carry[3] the cursor — the
+            # same positions with or without the trailing digest dict
+            return jnp.logical_and(
+                ~jnp.all(carry[0][4]), carry[3] < ring_capacity
+            )
 
         def body(carry):
-            inner, ring, cnts, it = carry
-            inner, y_block, cnt = step(
-                params, temps, seeds, budgets, extra, inner
-            )
+            inner, ring, cnts, it = carry[:4]
+            if numerics:
+                with numerics_tape(sites=_NUMERICS_SITES) as tape:
+                    inner, y_block, cnt = step(
+                        params, temps, seeds, budgets, extra, inner
+                    )
+                digs = merge_digest_trees(carry[4], tape.digests())
+            else:
+                inner, y_block, cnt = step(
+                    params, temps, seeds, budgets, extra, inner
+                )
             ring = jax.lax.dynamic_update_index_in_dim(ring, y_block, it, 0)
             cnts = jax.lax.dynamic_update_index_in_dim(cnts, cnt, it, 0)
-            return (inner, ring, cnts, it + 1)
+            out = (inner, ring, cnts, it + 1)
+            return out + ((digs,) if numerics else ())
 
-        (kv, _, _, _, _, _), ring, cnts, it = jax.lax.while_loop(
-            cond,
-            body,
-            ((kv, toks, positions, steps, fin0, hist), ring0, cnt0,
-             jnp.int32(0)),
-        )
+        init = ((kv, toks, positions, steps, fin0, hist), ring0, cnt0,
+                jnp.int32(0))
+        if numerics:
+            init = init + (_zero_site_digests(),)
+        res = jax.lax.while_loop(cond, body, init)
+        (kv, _, _, _, _, _), ring, cnts, it = res[:4]
+        if numerics:
+            return kv, ring, cnts, it, res[4]
         return kv, ring, cnts, it
 
     return run

@@ -20,6 +20,7 @@ from ..ops.attention import (
     multihead_attention,
     slot_cached_attention,
 )
+from ..obs.numerics import tap as _num_tap
 from ..ops.flash_attention import resolve_use_flash
 from ..utils.compat import axis_size
 
@@ -188,14 +189,14 @@ class GPT2(nn.Module):
             )
         else:
             pos = jnp.arange(s)
-        x = self.tok_emb(tokens) + self.pos_emb(pos)[None]
-        for blk in self.blocks:
-            x = blk(x)
+        x = _num_tap("tok_emb", self.tok_emb(tokens) + self.pos_emb(pos)[None])
+        for i, blk in enumerate(self.blocks):
+            x = _num_tap(f"block{i}", blk(x))
         x = self.ln_f(x)
         if return_hidden:
             return x
         # weight-tied head (GPT-2 ties lm_head to tok_emb)
-        return x @ self.tok_emb.weight.T
+        return _num_tap("logits", x @ self.tok_emb.weight.T)
 
     # -- KV-cache decode (generation.generate contract, like Llama) -------
 

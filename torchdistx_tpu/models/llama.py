@@ -27,6 +27,7 @@ from ..ops.attention import (
     slot_cached_attention,
     sp_attention,
 )
+from ..obs.numerics import tap as _num_tap
 from ..ops.flash_attention import resolve_use_flash
 
 __all__ = ["LlamaConfig", "Llama", "llama_configs", "pp_stage"]
@@ -372,12 +373,15 @@ class Llama(nn.Module):
             if cfg.remat
             else (lambda blk, h: blk(h, rope))
         )
-        for blk in self.blocks:
-            x = block_fn(blk, x)
+        x = _num_tap("tok_emb", x)
+        for i, blk in enumerate(self.blocks):
+            # tapped on the block RESULT, outside the remat wrapper —
+            # digests must not be recomputed (or dropped) by checkpoint
+            x = _num_tap(f"block{i}", block_fn(blk, x))
         x = self.norm(x)
         if return_hidden:
             return x
-        return self.lm_head(x)
+        return _num_tap("logits", self.lm_head(x))
 
     # -- incremental decoding (KV cache) ----------------------------------
 

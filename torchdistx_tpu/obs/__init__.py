@@ -69,6 +69,17 @@ tracing & SLO observatory"):
   route/queued/prefill/handoff/decode spans on the shared monotonic
   timebase and stitch them with Perfetto flow events keyed on the
   process-unique ``Request.trace_id`` (``ServeFleet.dump_trace``).
+
+PR 19 adds the *numerics observatory* — the first layer over values
+rather than resources (docs/observability.md "Numerics observatory"):
+
+- :mod:`~torchdistx_tpu.obs.numerics` — ``tdx-numerics-v1`` digests
+  (exact nonfinite/zero counts + base-2 exponent histograms, plus
+  per-platform max-abs/rms) fused into the existing jitted train /
+  serve / replay programs and harvested only at their existing sync
+  boundaries; nonfinite provenance names the earliest bad site in
+  flight events; exported as ``tdx_numerics_*`` gauges, Perfetto
+  counter tracks, and exact ledger counter rows.
 """
 
 from .comm import CommProfile, comm_audit, record_collective
@@ -112,6 +123,17 @@ from .metrics import (
     parse_prometheus,
     render_prometheus,
     start_metrics_server,
+)
+from .numerics import (
+    NUMERICS_SCHEMA,
+    HostDigest,
+    NumericsBook,
+    array_digest,
+    numerics_enabled,
+    numerics_tape,
+    tap,
+    tap_error,
+    tree_digest,
 )
 from .recompile import RecompileWatcher, recompile_scope, track_jit_cache
 from .slo import (
@@ -184,4 +206,13 @@ __all__ = [
     "compute_cost_card",
     "validate_cost_card",
     "DispatchWatchdog",
+    "NUMERICS_SCHEMA",
+    "HostDigest",
+    "NumericsBook",
+    "array_digest",
+    "numerics_enabled",
+    "numerics_tape",
+    "tap",
+    "tap_error",
+    "tree_digest",
 ]

@@ -309,6 +309,10 @@ _SERVE_WORKLOAD_KEYS = (
     "scenario",
     "autoscale",
     "plan",
+    # the numerics A/B phase's on-leg (obs/numerics.py): True only in
+    # that phase's record, so digest-era rows can never collide with
+    # default-run pins (None-filtered like ``plan``)
+    "numerics",
 )
 
 
@@ -378,6 +382,30 @@ def ingest_serve_record(record: dict, **kw) -> List[dict]:
         am = phase.get("autoscale_metrics") or {}
         for name, v in (am.get("counters") or {}).items():
             row(name, v, "counter")
+        # numerics observatory (obs/numerics.py): the embedded digest
+        # book's exact integer fields — nonfinite / zeros / count /
+        # hist_hash per tap site.  Reduction-order-invariant element
+        # counts, so they gate bit-identically like dispatch counters;
+        # the site joins the workload (its own fingerprint family)
+        nb = phase.get("numerics_book") or {}
+        for site, d in sorted((nb.get("sites") or {}).items()):
+            site_workload = dict(workload, numerics_site=site)
+            for field in ("nonfinite", "zeros", "count", "hist_hash"):
+                v = d.get(field)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    continue
+                rows.append(
+                    make_row(
+                        source="bench_serve",
+                        metric=f"numerics_{field}",
+                        value=v,
+                        metric_class="counter",
+                        quality=quality,
+                        workload=site_workload,
+                        platform=platform,
+                        **meta,
+                    )
+                )
         derived = m.get("derived") or {}
         # counter-derived exact ratios (host_syncs / tokens etc.): same
         # counters ⇒ same double, so they gate exactly too

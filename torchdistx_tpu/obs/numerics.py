@@ -1,0 +1,729 @@
+"""Numerics observatory: on-device value digests with nonfinite
+provenance (schema ``tdx-numerics-v1``).
+
+Every other obs layer watches *resources* — bytes (obs/memory), FLOPs
+(obs/cost), microseconds (obs/trace), collectives (obs/comm).  This one
+watches the *values*: where the NaNs are, where the zeros are, what the
+magnitude distribution of an activation / gradient / logit / KV-error
+tensor looks like — as a cheap, always-comparable summary instead of the
+tensors themselves.
+
+Design rules (the whole module follows from these):
+
+1. **Fused, never fetched.**  A digest is a handful of reductions traced
+   INTO an existing jitted program (:func:`array_digest` at tap sites
+   inside the train step, the serve prefill/decode bodies, replay
+   chunks).  The device arrays ride the program's existing outputs and
+   are read back only at sync boundaries the host already owns (the
+   trainer's log-window ``block_until_ready``, the serve engine's
+   per-dispatch fetch / ring drain) — enabling digests adds ZERO host
+   syncs and ZERO extra dispatches; the cost shows up only in the
+   program's cost card.
+2. **Exact integer core.**  ``nonfinite`` / ``zeros`` / ``count`` and the
+   base-2 exponent-bucket histogram of ``|x|`` are integer sums of
+   per-element predicates: associative, reduction-order-invariant, hence
+   bit-identical across runs AND across mesh shapes (an int sum is the
+   same number however XLA partitions it).  These are ledger
+   ``metric_class: counter`` material and gate strict.
+3. **Determinism classes are explicit.**  ``max_abs`` (order-invariant
+   in exact arithmetic) and ``rms`` (a float sum of squares) are
+   deterministic on a fixed platform+sharding but NOT across meshes —
+   they are published as gauges and never pinned as counters.  The
+   ``hist_hash`` (an FNV-1a fold of the integer fields) is in the exact
+   class: one counter row pins the entire histogram.
+
+Tap points use the trace-time tape (the ``obs/comm.py`` audit idiom): a
+thread-local context installed around a traced region; ``tap(site, x)``
+is an identity that records ``array_digest(x)`` into the innermost tape
+when one is active and disappears entirely when none is.  Inside
+``lax.scan`` / ``while_loop`` bodies the tape's sites must be declared
+up front (``numerics_tape(sites=...)``) so the digest accumulator can
+live in the loop carry with a static structure.
+
+Gating: ``TDX_NUMERICS=1`` turns the trainer/serve/replay taps on
+(:func:`numerics_enabled`); the suite pins it off in tests/conftest.py
+exactly like ``TDX_COST_CARDS`` so default programs stay byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "NUMERICS_SCHEMA",
+    "NBUCKETS",
+    "BUCKET_WIDTH",
+    "numerics_enabled",
+    "array_digest",
+    "error_digest",
+    "zero_digest",
+    "merge_digests",
+    "merge_digest_trees",
+    "reduce_stacked_digests",
+    "allreduce_digests",
+    "tree_group_digest",
+    "provenance_key",
+    "numerics_tape",
+    "active_tape",
+    "tap",
+    "tap_error",
+    "tree_digest",
+    "HostDigest",
+    "NumericsBook",
+]
+
+NUMERICS_SCHEMA = "tdx-numerics-v1"
+
+#: base-2 exponent buckets of |x|: bucket ``i`` holds finite nonzero
+#: elements whose f32 BIASED exponent field satisfies ``bexp // 8 == i``
+#: — 32 buckets of 8 exponents each tile the entire f32 range exactly
+#: (bucket 0 additionally holds all subnormals, bexp == 0).  Bucketing
+#: reads the bit pattern, not float comparisons: XLA's FTZ semantics
+#: differ between fusions on the same platform, but ``bitcast ->
+#: integer field extract`` is one answer everywhere.
+NBUCKETS = 32
+BUCKET_WIDTH = 8
+
+#: the integer digest fields, in merge order (sum-merged; ``exp_hist``
+#: elementwise).  ``max_abs``/``sumsq`` are the float tail.
+_INT_FIELDS = ("nonfinite", "zeros", "count")
+
+_OFF_VALUES = ("0", "false", "")
+
+
+def numerics_enabled(default: bool = False) -> bool:
+    """``TDX_NUMERICS`` as the global default for the trainer / serve /
+    replay taps.  Components also take an explicit constructor flag
+    (``ServeEngine(numerics=True)``) which wins over the env; this is
+    the resolution for ``None``-means-env."""
+    v = os.environ.get("TDX_NUMERICS")
+    if v is None:
+        return default
+    return v.strip().lower() not in _OFF_VALUES
+
+
+# --------------------------------------------------------------------------
+# device-side digests (traced; jnp imported lazily so host-only consumers
+# — perf_gate, check_obs_artifacts — can read books without jax)
+# --------------------------------------------------------------------------
+
+
+def zero_digest():
+    """The merge identity, with the loop-carry-ready static structure."""
+    import jax.numpy as jnp
+
+    return {
+        "nonfinite": jnp.int32(0),
+        "zeros": jnp.int32(0),
+        "count": jnp.int32(0),
+        "exp_hist": jnp.zeros((NBUCKETS,), jnp.int32),
+        "max_abs": jnp.float32(0.0),
+        "sumsq": jnp.float32(0.0),
+    }
+
+
+def array_digest(x) -> Dict[str, Any]:
+    """Digest one array with a fixed handful of reductions (traced into
+    whatever program is being built — never dispatched on its own).
+
+    Integer fields are per-element predicate sums: exact and
+    reduction-order-invariant (rule 2 of the module docstring).
+    ``max_abs``/``sumsq`` exclude nonfinite elements so one NaN cannot
+    poison the magnitude summary it is being counted beside.
+
+    ``count`` is int32: exact below 2**31 elements per merged site —
+    every current tap site is orders of magnitude under that; a site
+    that could overflow must shard its digests across more sites.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    xf = x.astype(jnp.float32)  # bf16/f16 -> f32 is exact
+    # classify on the BIT PATTERN: float predicates are not reliable for
+    # exact counting (XLA CPU flushes subnormals in some fusions and not
+    # others, so `ax == 0` and `ax > 0` can both answer True for the
+    # same element); the integer magnitude field gives one answer on
+    # every platform and keeps the identity
+    #   count == nonfinite + zeros + sum(exp_hist)
+    # exact by construction.
+    bits = lax.bitcast_convert_type(xf, jnp.int32)
+    mag = bits & jnp.int32(0x7FFFFFFF)
+    nonfinite = mag >= jnp.int32(0x7F800000)  # inf and nan
+    zero = mag == 0
+    pos = ~nonfinite & ~zero
+    bexp = mag >> 23  # biased exponent field, 0..255
+    idx = jnp.clip(bexp // BUCKET_WIDTH, 0, NBUCKETS - 1).reshape(-1)
+    hist = (
+        jnp.zeros((NBUCKETS,), jnp.int32)
+        .at[idx]
+        .add(pos.astype(jnp.int32).reshape(-1))
+    )
+    safe = jnp.where(nonfinite, jnp.float32(0.0), jnp.abs(xf))
+    return {
+        "nonfinite": jnp.sum(nonfinite).astype(jnp.int32),
+        "zeros": jnp.sum(zero).astype(jnp.int32),
+        "count": jnp.int32(int(np.prod(x.shape)) if x.shape else 1),
+        "exp_hist": hist,
+        "max_abs": jnp.max(safe) if x.size else jnp.float32(0.0),
+        "sumsq": jnp.sum(safe * safe),
+    }
+
+
+def error_digest(x, x_hat) -> Dict[str, Any]:
+    """Digest of ``|x - x_hat|`` (both promoted to f32) — the KV
+    dequantization-error probe: ``max_abs`` is the worst per-element
+    error, ``rms`` follows at harvest."""
+    import jax.numpy as jnp
+
+    return array_digest(
+        x.astype(jnp.float32) - x_hat.astype(jnp.float32)
+    )
+
+
+def merge_digests(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Associative merge (sum / elementwise sum / max) — the property
+    that makes digests loop-carry- and cross-device-foldable."""
+    import jax.numpy as jnp
+
+    return {
+        "nonfinite": a["nonfinite"] + b["nonfinite"],
+        "zeros": a["zeros"] + b["zeros"],
+        "count": a["count"] + b["count"],
+        "exp_hist": a["exp_hist"] + b["exp_hist"],
+        "max_abs": jnp.maximum(a["max_abs"], b["max_abs"]),
+        "sumsq": a["sumsq"] + b["sumsq"],
+    }
+
+
+def merge_digest_trees(
+    a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """Merge two ``{site: digest}`` dicts (microbatch-scan accumulation:
+    ``accumulate_grads(..., aux_merge=merge_digest_trees)``).  Site sets
+    must match — they do by construction, both sides traced from the
+    same tap program."""
+    if set(a) != set(b):
+        raise ValueError(
+            f"digest site mismatch: {sorted(a)} vs {sorted(b)}"
+        )
+    return {site: merge_digests(a[site], b[site]) for site in a}
+
+
+def reduce_stacked_digests(
+    digests: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """Merge a ``{site: digest}`` tree whose fields carry a stacked
+    leading axis — the ``ys`` of a microbatch ``lax.scan`` — into single
+    digests (sum over axis 0; ``max_abs`` maxes)."""
+    import jax.numpy as jnp
+
+    out = {}
+    for site, d in digests.items():
+        out[site] = {
+            "nonfinite": jnp.sum(d["nonfinite"], axis=0),
+            "zeros": jnp.sum(d["zeros"], axis=0),
+            "count": jnp.sum(d["count"], axis=0),
+            "exp_hist": jnp.sum(d["exp_hist"], axis=0),
+            "max_abs": jnp.max(d["max_abs"], axis=0),
+            "sumsq": jnp.sum(d["sumsq"], axis=0),
+        }
+    return out
+
+
+def _path_part(entry) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def tree_group_digest(
+    tree: Any, prefix: str = "", depth: int = 2
+) -> Dict[str, Dict[str, Any]]:
+    """Digest every inexact leaf of a pytree at TRACE time (inside
+    whatever program is being built), merged into per-group digests
+    keyed by the first ``depth`` dot-separated path components —
+    ``params/blocks.0``, ``grads/fc1.weight``, ...  This is the
+    param/grad tap the train steps fuse into their jitted step."""
+    import jax
+
+    groups: Dict[str, Dict[str, Any]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "dtype") or not np.issubdtype(
+            np.dtype(leaf.dtype), np.inexact
+        ):
+            continue
+        dotted = ".".join(_path_part(p) for p in path)
+        key = prefix + ".".join(dotted.split(".")[:depth])
+        d = array_digest(leaf)
+        prev = groups.get(key)
+        groups[key] = d if prev is None else merge_digests(prev, d)
+    return groups
+
+
+_STAGE_RANK = {"params": 0, "act": 1, "logits": 2, "loss": 3, "grads": 4}
+
+
+def provenance_key(site: str):
+    """Sort key restoring PROGRAM order over the harvested site names
+    (jit's dict outputs come back key-sorted, losing tap order):
+    params → activations → logits → loss → grads, natural-sorted
+    within a stage so ``act/block10`` follows ``act/block2``."""
+    stage = site.split("/", 1)[0]
+    rank = _STAGE_RANK.get(stage, len(_STAGE_RANK) + 1)
+    nat = tuple(
+        (0, int(p)) if p.isdigit() else (1, p)
+        for p in re.split(r"(\d+)", site)
+        if p
+    )
+    return (rank, nat)
+
+
+def allreduce_digests(
+    digests: Dict[str, Dict[str, Any]], axes, mesh_shape: Dict[str, int]
+) -> Dict[str, Dict[str, Any]]:
+    """Fold per-device digests into global ones inside a ``shard_map``
+    body: integer fields ``psum`` (exact in any order — the cross-mesh
+    bit-identity claim), ``max_abs`` ``pmax``, ``sumsq`` ``psum``.
+
+    The collectives are booked into the comm audit (TDX103) with their
+    real payload: one digest is ``3 + NBUCKETS`` int32 + 2 f32 words.
+    """
+    from jax import lax
+
+    from .comm import record_collective
+
+    axes = tuple(axes)
+    if not axes or not digests:
+        return digests
+    group = 1
+    for ax in axes:
+        group *= int(mesh_shape[ax])
+    payload = len(digests) * (4 * (3 + NBUCKETS) + 4 * 2)
+    record_collective(
+        "psum", axes[0] if len(axes) == 1 else axes,
+        payload_bytes=payload, count=2, axis_size=group,
+    )
+    record_collective(
+        "pmax", axes[0] if len(axes) == 1 else axes,
+        payload_bytes=len(digests) * 4, axis_size=group,
+    )
+    out = {}
+    for site, d in digests.items():
+        out[site] = {
+            "nonfinite": lax.psum(d["nonfinite"], axes),
+            "zeros": lax.psum(d["zeros"], axes),
+            "count": lax.psum(d["count"], axes),
+            "exp_hist": lax.psum(d["exp_hist"], axes),
+            "max_abs": lax.pmax(d["max_abs"], axes),
+            "sumsq": lax.psum(d["sumsq"], axes),
+        }
+    return out
+
+
+# --------------------------------------------------------------------------
+# trace-time tape
+# --------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+class Tape:
+    """Ordered trace-time digest accumulator.  ``sites=None`` accepts
+    every tap (straight-line programs); a declared site tuple restricts
+    the tape to exactly those sites — required inside scan/while bodies,
+    where the accumulator structure must be static across iterations."""
+
+    def __init__(self, sites: Optional[Iterable[str]] = None):
+        self.sites = None if sites is None else tuple(sites)
+        self._digests: Dict[str, Dict[str, Any]] = {}
+        if self.sites is not None:
+            for s in self.sites:
+                self._digests[s] = zero_digest()
+
+    def accepts(self, site: str) -> bool:
+        return self.sites is None or site in self.sites
+
+    def record(self, site: str, digest: Dict[str, Any]) -> None:
+        prev = self._digests.get(site)
+        self._digests[site] = (
+            digest if prev is None else merge_digests(prev, digest)
+        )
+
+    def digests(self) -> Dict[str, Dict[str, Any]]:
+        """The accumulated ``{site: digest}`` dict, tap order preserved
+        (declared order when ``sites`` was given)."""
+        return dict(self._digests)
+
+
+@contextmanager
+def numerics_tape(sites: Optional[Iterable[str]] = None):
+    """Install a :class:`Tape` for the duration of a traced region.
+    Nesting is LIFO; ``tap`` records into the innermost tape only."""
+    tape = Tape(sites)
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(tape)
+    try:
+        yield tape
+    finally:
+        stack.pop()
+
+
+def active_tape() -> Optional[Tape]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+def tap(site: str, x):
+    """Identity on ``x``; records ``array_digest(x)`` into the innermost
+    active tape.  A no-op returning ``x`` unchanged when no tape is
+    active (or the tape doesn't accept ``site``) — model forwards carry
+    these permanently at zero cost to untapped programs."""
+    tape = active_tape()
+    if tape is None or not tape.accepts(site):
+        return x
+    if not hasattr(x, "dtype") or not np.issubdtype(
+        np.dtype(x.dtype), np.inexact
+    ):
+        return x
+    tape.record(site, array_digest(x))
+    return x
+
+
+def tap_error(site: str, x, x_hat) -> None:
+    """Record ``error_digest(x, x_hat)`` at ``site`` (no identity value
+    to thread — error taps are observation-only)."""
+    tape = active_tape()
+    if tape is None or not tape.accepts(site):
+        return
+    tape.record(site, error_digest(x, x_hat))
+
+
+def tree_digest(tree: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """One jitted pass digesting every inexact leaf of a pytree —
+    ``{prefix + path: digest}`` of DEVICE arrays.  This is the
+    init-time probe (deferred-vs-eager equality as digest equality);
+    it IS its own dispatch, so it never belongs on a steady-state path.
+    """
+    import jax
+
+    paths = []
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "dtype") or not np.issubdtype(
+            np.dtype(leaf.dtype), np.inexact
+        ):
+            continue
+        paths.append(prefix + ".".join(_path_part(p) for p in path))
+        leaves.append(leaf)
+
+    def digest_all(ls):
+        return [array_digest(l) for l in ls]
+
+    digs = jax.jit(digest_all)(leaves)
+    return dict(zip(paths, digs))
+
+
+# --------------------------------------------------------------------------
+# host-side harvest
+# --------------------------------------------------------------------------
+
+
+class HostDigest:
+    """One site's digest on the host: plain ints / floats / an int list,
+    merged across harvests with the same associative rules as the device
+    side.  ``exp_hist`` equality (and the derived ``hist_hash``) is the
+    exact cross-run/cross-mesh comparison; ``max_abs``/``rms`` are the
+    per-platform floats."""
+
+    __slots__ = ("nonfinite", "zeros", "count", "exp_hist", "max_abs", "sumsq")
+
+    def __init__(self, nonfinite=0, zeros=0, count=0, exp_hist=None,
+                 max_abs=0.0, sumsq=0.0):
+        self.nonfinite = int(nonfinite)
+        self.zeros = int(zeros)
+        self.count = int(count)
+        self.exp_hist = (
+            [0] * NBUCKETS if exp_hist is None else [int(v) for v in exp_hist]
+        )
+        self.max_abs = float(max_abs)
+        self.sumsq = float(sumsq)
+
+    @classmethod
+    def from_device(cls, d: Dict[str, Any]) -> "HostDigest":
+        """Build from harvested (already device_get) digest arrays."""
+        return cls(
+            nonfinite=np.asarray(d["nonfinite"]),
+            zeros=np.asarray(d["zeros"]),
+            count=np.asarray(d["count"]),
+            exp_hist=np.asarray(d["exp_hist"]).tolist(),
+            max_abs=np.asarray(d["max_abs"]),
+            sumsq=np.asarray(d["sumsq"]),
+        )
+
+    def merge(self, other: "HostDigest") -> "HostDigest":
+        return HostDigest(
+            nonfinite=self.nonfinite + other.nonfinite,
+            zeros=self.zeros + other.zeros,
+            count=self.count + other.count,
+            exp_hist=[
+                a + b for a, b in zip(self.exp_hist, other.exp_hist)
+            ],
+            max_abs=max(self.max_abs, other.max_abs),
+            sumsq=self.sumsq + other.sumsq,
+        )
+
+    @property
+    def rms(self) -> float:
+        return math.sqrt(self.sumsq / self.count) if self.count else 0.0
+
+    @property
+    def hist_hash(self) -> int:
+        """FNV-1a (64-bit) fold of the exact integer fields — one
+        counter row that pins the whole histogram bit-identically."""
+        h = 0xCBF29CE484222325
+        for v in (self.nonfinite, self.zeros, self.count, *self.exp_hist):
+            h ^= int(v) & 0xFFFFFFFFFFFFFFFF
+            h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        # keep it inside the f64-exact integer range: every consumer
+        # downstream (JSON, ledger doubles, Prometheus) holds counters
+        # as doubles, and a >2**53 int would silently round
+        return h & 0x1FFFFFFFFFFFFF
+
+    def int_fields(self) -> Dict[str, int]:
+        """The exact-class fields (ledger counter material)."""
+        return {
+            "nonfinite": self.nonfinite,
+            "zeros": self.zeros,
+            "count": self.count,
+            "hist_hash": self.hist_hash,
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "nonfinite": self.nonfinite,
+            "zeros": self.zeros,
+            "count": self.count,
+            "exp_hist": list(self.exp_hist),
+            "hist_hash": self.hist_hash,
+            "max_abs": self.max_abs,
+            "rms": self.rms,
+        }
+
+    def __eq__(self, other) -> bool:  # exact-field equality
+        if not isinstance(other, HostDigest):
+            return NotImplemented
+        return (
+            self.nonfinite == other.nonfinite
+            and self.zeros == other.zeros
+            and self.count == other.count
+            and self.exp_hist == other.exp_hist
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"HostDigest(count={self.count}, nonfinite={self.nonfinite}, "
+            f"zeros={self.zeros}, max_abs={self.max_abs:.3e})"
+        )
+
+
+class NumericsBook:
+    """Ordered per-site digest ledger on the host — the harvest target
+    of every tap surface (trainer log windows, serve drains, replay
+    chunks) and the source of all three exports: ``tdx_numerics_*``
+    gauges (:meth:`collector`), Perfetto counter tracks
+    (:meth:`emit_counter_tracks`), and exact ledger counter rows
+    (:meth:`counter_rows` / the bench records' ``numerics`` block).
+
+    Provenance: site order is FIRST-UPDATE order — the program order of
+    the tap sites — so :meth:`first_nonfinite_site` names the earliest
+    site (layer / program) whose nonfinite count went positive, and
+    ``first_nonfinite_step`` remembers when.
+    """
+
+    def __init__(self) -> None:
+        self._sites: Dict[str, HostDigest] = {}
+        self._last: Dict[str, HostDigest] = {}
+        self.harvests = 0
+        self.first_nonfinite: Optional[str] = None
+        self.first_nonfinite_step: Optional[int] = None
+
+    def update(
+        self, site: str, digest: HostDigest, step: Optional[int] = None
+    ) -> None:
+        prev = self._sites.get(site)
+        self._sites[site] = digest if prev is None else prev.merge(digest)
+        self._last[site] = digest
+
+    def update_tree(
+        self, digests: Dict[str, Any], step: Optional[int] = None
+    ) -> None:
+        """Harvest one ``{site: digest}`` dict of ALREADY-FETCHED arrays
+        (the caller owns the sync boundary; this method never touches
+        the device).  Sites are visited in :func:`provenance_key` order
+        — program order — so first-nonfinite attribution names the
+        EARLIEST site even when one harvest carries several."""
+        self.harvests += 1
+        for site in sorted(digests, key=provenance_key):
+            d = digests[site]
+            hd = d if isinstance(d, HostDigest) else HostDigest.from_device(d)
+            self.update(site, hd, step=step)
+            if hd.nonfinite > 0 and self.first_nonfinite is None:
+                self.first_nonfinite = site
+                self.first_nonfinite_step = step
+
+    def sites(self) -> List[str]:
+        return list(self._sites)
+
+    def digest(self, site: str) -> Optional[HostDigest]:
+        return self._sites.get(site)
+
+    def last(self, site: str) -> Optional[HostDigest]:
+        """The most recent single harvest of ``site`` (un-merged) — what
+        drift checks compare window to window."""
+        return self._last.get(site)
+
+    def first_nonfinite_site(self) -> Optional[str]:
+        """Earliest tap site (program order) whose nonfinite count went
+        positive across this book's lifetime, or None."""
+        return self.first_nonfinite
+
+    def counter_rows(self) -> List[dict]:
+        """The exact-class fields as ``{site, metric, value}`` triples —
+        what bench records embed and ``obs/ledger.py`` ingests as
+        ``metric_class: counter`` rows (workload key ``numerics``)."""
+        rows = []
+        for site, d in self._sites.items():
+            for metric, value in d.int_fields().items():
+                rows.append(
+                    {"site": site, "metric": f"numerics_{metric}",
+                     "value": value}
+                )
+        return rows
+
+    def to_json(self) -> dict:
+        return {
+            "schema": NUMERICS_SCHEMA,
+            "harvests": self.harvests,
+            "first_nonfinite_site": self.first_nonfinite,
+            "first_nonfinite_step": self.first_nonfinite_step,
+            "sites": {s: d.to_json() for s, d in self._sites.items()},
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "NumericsBook":
+        if data.get("schema") != NUMERICS_SCHEMA:
+            raise ValueError(
+                f"expected schema {NUMERICS_SCHEMA!r}, "
+                f"got {data.get('schema')!r}"
+            )
+        book = cls()
+        book.harvests = int(data.get("harvests", 0))
+        book.first_nonfinite = data.get("first_nonfinite_site")
+        book.first_nonfinite_step = data.get("first_nonfinite_step")
+        for site, d in (data.get("sites") or {}).items():
+            book._sites[site] = HostDigest(
+                nonfinite=d["nonfinite"], zeros=d["zeros"],
+                count=d["count"], exp_hist=d["exp_hist"],
+                max_abs=d.get("max_abs", 0.0),
+                sumsq=(
+                    float(d.get("rms", 0.0)) ** 2 * d["count"]
+                ),
+            )
+        return book
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)
+
+    def collector(self, prefix: str = "tdx_numerics"):
+        """``obs.metrics`` collector: ``{prefix}_{field}{site=...}``
+        gauges per site — register with
+        ``registry.register_collector(book.collector(), obj=book)``
+        (the ServeMetrics weakref idiom: a rebound book drops out of the
+        exposition once collected)."""
+        import weakref
+
+        from .metrics import MetricFamily
+
+        ref = weakref.ref(self)
+
+        def collect():
+            book = ref()
+            if book is None:
+                return []
+            fams = []
+            gauges = (
+                ("nonfinite", lambda d: d.nonfinite),
+                ("zeros", lambda d: d.zeros),
+                ("count", lambda d: d.count),
+                ("hist_hash", lambda d: d.hist_hash),
+                ("max_abs", lambda d: d.max_abs),
+                ("rms", lambda d: d.rms),
+            )
+            for field, get in gauges:
+                fam = MetricFamily(f"{prefix}_{field}", "gauge")
+                for site, d in book._sites.items():
+                    fam.add(get(d), site=site)
+                if book._sites:
+                    fams.append(fam)
+            fams.append(
+                MetricFamily(f"{prefix}_harvests_total", "counter").add(
+                    book.harvests
+                )
+            )
+            return fams
+
+        return collect
+
+    def emit_counter_tracks(self, tracer=None) -> None:
+        """One Perfetto counter sample per site on the shared timebase
+        (``obs.trace.get_tracer().counter``) — call at each harvest so
+        nonfinite/zero counts line up beside the span timeline."""
+        if tracer is None:
+            from .trace import get_tracer
+
+            tracer = get_tracer()
+        for site, d in self._last.items():
+            tracer.counter(
+                f"numerics/{site}",
+                nonfinite=float(d.nonfinite),
+                zeros=float(d.zeros),
+                max_abs=float(d.max_abs),
+            )
+
+    def drift_rows(
+        self, expected: Dict[str, Dict[str, int]]
+    ) -> List[dict]:
+        """Digest deltas vs pinned expectations: for each expected site,
+        compare the exact integer fields of the MERGED digest and return
+        one row per mismatch (empty == no drift).  This is the
+        perf_gate-adjacent check ``check_obs_artifacts.py --numerics``
+        runs against a record's embedded pins."""
+        rows = []
+        for site, pins in expected.items():
+            d = self._sites.get(site)
+            if d is None:
+                rows.append(
+                    {"site": site, "metric": "missing", "expected": pins,
+                     "actual": None}
+                )
+                continue
+            actual = d.int_fields()
+            for metric, want in pins.items():
+                got = actual.get(metric)
+                if got != want:
+                    rows.append(
+                        {"site": site, "metric": metric,
+                         "expected": want, "actual": got}
+                    )
+        return rows

@@ -317,11 +317,13 @@ def slot_cached_attention(
         raise ValueError(f"window must be >= 1, got {window}")
     quantized = len(cache) == 4
     if quantized:
-        from ..serve.kv_cache import dequantize_kv, quantize_kv
+        from ..serve.kv_cache import _tap_quant, dequantize_kv, quantize_kv
 
         ck, cv, cks, cvs = cache
         qk_new, sk_new = quantize_kv(k_new)
         qv_new, sv_new = quantize_kv(v_new)
+        _tap_quant(k_new, qk_new, sk_new)
+        _tap_quant(v_new, qv_new, sv_new)
     else:
         ck, cv = cache
         cks = cvs = None

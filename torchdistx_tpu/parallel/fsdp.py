@@ -76,14 +76,23 @@ def accumulate_grads(
     batch: Any,
     accum: int,
     split_fn: Callable[[Any, int, int], Any],
+    has_aux: bool = False,
+    aux_merge: Optional[Callable[[Any], Any]] = None,
 ):
     """Shared microbatch gradient accumulation: validate the batch's
     common leading dim, split it with ``split_fn(leaf, lead, accum)``
     (callers inject contiguous vs strided strategies), scan
     ``value_and_grad`` over the microbatches accumulating in f32, and
-    return ``(mean_loss, grads_in_param_dtype)``."""
+    return ``(mean_loss, grads_in_param_dtype)``.
+
+    With ``has_aux`` the loss_fn returns ``(loss, aux)`` and the result
+    becomes ``((mean_loss, aux), grads)``; under accumulation the
+    per-microbatch auxes come back scan-stacked on a leading axis
+    unless ``aux_merge`` folds them (the numerics taps pass
+    ``obs.numerics.reduce_stacked_digests`` — aux is the only escape
+    hatch for forward-pass observables under ``value_and_grad``)."""
     if accum == 1:
-        return jax.value_and_grad(loss_fn)(params, batch)
+        return jax.value_and_grad(loss_fn, has_aux=has_aux)(params, batch)
     leads = {
         getattr(x, "shape", ())[:1] for x in jax.tree_util.tree_leaves(batch)
     }
@@ -106,19 +115,30 @@ def accumulate_grads(
 
     def body(carry, mb):
         loss_acc, g_acc = carry
-        loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            aux = None
         g_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), g_acc, grads
         )
-        return (loss_acc + loss, g_acc), None
+        return (loss_acc + loss, g_acc), aux
 
-    (loss_sum, g_sum), _ = lax.scan(
+    (loss_sum, g_sum), aux_stack = lax.scan(
         body, (jnp.zeros((), jnp.float32), g0), micro
     )
     grads = jax.tree_util.tree_map(
         lambda p, g: (g / accum).astype(p.dtype), params, g_sum
     )
-    return loss_sum / accum, grads
+    mean_loss = loss_sum / accum
+    if has_aux:
+        if aux_merge is not None:
+            aux_stack = aux_merge(aux_stack)
+        return (mean_loss, aux_stack), grads
+    return mean_loss, grads
 
 
 def contiguous_split(x, lead, accum):
@@ -250,8 +270,15 @@ class ShardedTrainStep:
     # replica layouts (leading per-replica dim) stay plan-less: their
     # lead-dim specs are not expressible as path rules.
     plan: Optional[Any] = None
+    # numerics observatory (obs/numerics.py): fuse per-layer activation,
+    # per-param-group param/grad, and loss digests into the jitted step.
+    # None -> TDX_NUMERICS env; digests ride the step's outputs (zero
+    # extra dispatches) and land on self.last_digests, harvested by the
+    # Trainer at its existing log-window sync.
+    numerics: Optional[bool] = None
 
     def __post_init__(self) -> None:
+        self.last_digests = None
         if self.hook_state is None:
             self.hook_state = DefaultState()
         if (
@@ -414,20 +441,57 @@ class ShardedTrainStep:
         if accum < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum}")
 
+        from ..obs.numerics import (
+            allreduce_digests,
+            array_digest,
+            numerics_enabled,
+            numerics_tape,
+            reduce_stacked_digests,
+            tree_group_digest,
+        )
+
+        num_on = (
+            self.numerics
+            if self.numerics is not None
+            else numerics_enabled()
+        )
+        # activation digests are per-device partials over the local batch
+        # shard: psum over every batch-sharding axis makes the integer
+        # fields the exact GLOBAL counts (each (row, token) counted once,
+        # any mesh shape) — axes the batch is replicated over must not
+        # double-count, so they are excluded
+        digest_axes = tuple(dict.fromkeys(data_axes))
+
         def local_grad(p, batch):
             # inside shard_map the batch leaf is this device's local shard,
             # so a contiguous split is correct
-            return accumulate_grads(loss_fn, p, batch, accum, contiguous_split)
+            if num_on:
+
+                def loss_aux(pp, mb):
+                    with numerics_tape() as tape:
+                        loss = loss_fn(pp, mb)
+                    return loss, tape.digests()
+
+                (loss, acts), grads = accumulate_grads(
+                    loss_aux, p, batch, accum, contiguous_split,
+                    has_aux=True, aux_merge=reduce_stacked_digests,
+                )
+                return loss, grads, acts
+            loss, grads = accumulate_grads(
+                loss_fn, p, batch, accum, contiguous_split
+            )
+            return loss, grads, {}
 
         def grad_part(p_shards, batch, hook_step):
             full = tree_with_specs(gather_leaf, p_shards)
             if divergent:
                 # local view: drop the (size-1 per replica) leading dim
                 local = jax.tree_util.tree_map(lambda x: x[0], full)
-                loss, grads = local_grad(local, batch)
+                loss, grads, acts = local_grad(local, batch)
                 grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             else:
-                loss, grads = local_grad(full, batch)
+                loss, grads, acts = local_grad(full, batch)
+            acts = allreduce_digests(acts, digest_axes, mesh.shape)
             if grad_reduce_axes:
                 for _ax in grad_reduce_axes:
                     _record_comm(
@@ -444,10 +508,11 @@ class ShardedTrainStep:
                     "pmean", _ax, loss, axis_size=mesh.shape[_ax]
                 )
             loss = lax.pmean(loss, all_axes)
-            return loss, g_shards
+            return loss, g_shards, acts
 
         in_specs = (specs, batch_spec, P())
-        out_specs = (P(), specs)
+        # the digest dict's leaves are post-psum replicated across the mesh
+        out_specs = (P(), specs, P())
         sm = shard_map(
             grad_part,
             mesh=mesh,
@@ -459,11 +524,24 @@ class ShardedTrainStep:
         optimizer = self.optimizer
 
         def step(params, opt_state, batch, hook_step):
-            loss, grads = sm(params, batch, hook_step)
+            loss, grads, acts = sm(params, batch, hook_step)
+            digs = None
+            if num_on:
+                # program-order tap set: params -> activations -> loss ->
+                # grads, all fused into this one program (rule 1 of
+                # obs/numerics.py — zero extra dispatches)
+                digs = tree_group_digest(params, "params/")
+                digs.update(
+                    {f"act/{site}": d for site, d in acts.items()}
+                )
+                digs["loss"] = array_digest(loss)
+                digs.update(tree_group_digest(grads, "grads/"))
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
             )
+            if num_on:
+                return params, opt_state, loss, digs
             return params, opt_state, loss
 
         # donated carries keep the layouts they arrived with — without
@@ -471,8 +549,9 @@ class ShardedTrainStep:
         # placements (TDX101; the optimizer-state lesson applied to the
         # step itself)
         p_sh, o_sh = donated_carry_shardings(params, opt_state)
+        out_sh = (p_sh, o_sh, None, None) if num_on else (p_sh, o_sh, None)
         self._jitted = jax.jit(
-            step, donate_argnums=(0, 1), out_shardings=(p_sh, o_sh, None)
+            step, donate_argnums=(0, 1), out_shardings=out_sh
         )
         from ..obs.recompile import track_jit_cache
 
@@ -480,7 +559,12 @@ class ShardedTrainStep:
         del spec_tree
 
     def __call__(self, params: Any, opt_state: Any, batch: Any):
-        """Run one step.  Returns (params, opt_state, loss)."""
+        """Run one step.  Returns (params, opt_state, loss).
+
+        With numerics on, the step's fused digest dict (device arrays,
+        NOT fetched — the harvester owns the sync boundary) is stashed
+        on ``self.last_digests`` so the public 3-tuple stays stable.
+        """
         if self._jitted is None:
             self._build(params, opt_state)
         hook_step = self.hook_state.step_args()
@@ -488,4 +572,7 @@ class ShardedTrainStep:
             hook_step = jnp.int32(0)
         out = self._jitted(params, opt_state, batch, hook_step)
         self.hook_state.advance()
+        if len(out) == 4:
+            params, opt_state, loss, self.last_digests = out
+            return params, opt_state, loss
         return out

@@ -160,6 +160,14 @@ class GSPMDTrainStep:
     # accumulated in f32 — the standard fit-a-bigger-batch lever
     accum_steps: int = 1
     plan: Optional[ShardingPlan] = None
+    # numerics observatory (obs/numerics.py): fuse activation / param /
+    # grad / loss digests into the jitted step (None -> TDX_NUMERICS).
+    # Digests land on self.last_digests as device arrays; the public
+    # 3-tuple return is unchanged.  On this compiler-partitioned path
+    # the digests are reductions over GLOBAL arrays, so the integer
+    # fields are exact whatever the mesh — XLA partitions an int sum
+    # without changing its value.
+    numerics: Optional[bool] = None
 
     def __post_init__(self) -> None:
         opt = self.optimizer
@@ -168,16 +176,49 @@ class GSPMDTrainStep:
         if accum < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum}")
 
+        from ..obs.numerics import (
+            array_digest,
+            numerics_enabled,
+            numerics_tape,
+            reduce_stacked_digests,
+            tree_group_digest,
+        )
+
+        num_on = (
+            self.numerics if self.numerics is not None else numerics_enabled()
+        )
+        self._numerics_on = num_on
+        self.last_digests = None
+
         def step(params, opt_state, batch):
             # strided microbatches keep the full dp extent of the global
             # batch sharding (see strided_split)
-            loss, grads = accumulate_grads(
-                loss_fn, params, batch, accum, strided_split
-            )
+            digs = None
+            if num_on:
+
+                def loss_aux(p, mb):
+                    with numerics_tape() as tape:
+                        loss = loss_fn(p, mb)
+                    return loss, tape.digests()
+
+                (loss, acts), grads = accumulate_grads(
+                    loss_aux, params, batch, accum, strided_split,
+                    has_aux=True, aux_merge=reduce_stacked_digests,
+                )
+                digs = tree_group_digest(params, "params/")
+                digs.update({f"act/{s}": d for s, d in acts.items()})
+                digs["loss"] = array_digest(loss)
+                digs.update(tree_group_digest(grads, "grads/"))
+            else:
+                loss, grads = accumulate_grads(
+                    loss_fn, params, batch, accum, strided_split
+                )
             updates, opt_state = opt.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
             )
+            if num_on:
+                return params, opt_state, loss, digs
             return params, opt_state, loss
 
         self._step = step
@@ -199,10 +240,15 @@ class GSPMDTrainStep:
             p_sh, o_sh = self.plan.shardings_for(params, opt_state)
         else:
             p_sh, o_sh = donated_carry_shardings(params, opt_state)
+        out_sh = (
+            (p_sh, o_sh, None, None)
+            if self._numerics_on
+            else (p_sh, o_sh, None)
+        )
         self._jitted = jax.jit(
             self._step,
             donate_argnums=(0, 1),
-            out_shardings=(p_sh, o_sh, None),
+            out_shardings=out_sh,
         )
         # the ZeRO-2 gather's closed form, priced once from shape/dtype
         # metadata (stable across donation) and booked per dispatch
@@ -283,4 +329,8 @@ class GSPMDTrainStep:
                     count=r["count"],
                     axis_size=r["axis_size"],
                 )
-        return self._jitted(params, opt_state, batch)
+        out = self._jitted(params, opt_state, batch)
+        if len(out) == 4:
+            params, opt_state, loss, self.last_digests = out
+            return params, opt_state, loss
+        return out

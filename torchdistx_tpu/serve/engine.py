@@ -92,9 +92,16 @@ import jax.numpy as jnp
 
 from ..obs.comm import record_collective
 from ..obs.cost import CostBook, force_disabled as _cost_force_disabled
+from ..obs.numerics import (
+    NumericsBook,
+    numerics_enabled,
+    numerics_tape,
+    tap,
+)
 from ..obs.trace import get_tracer, request_trace_events
 
 from ..generation import (
+    _NUMERICS_SITES,
     _cached_jit,
     _check_sampling_args,
     _make_fused_decode,
@@ -120,6 +127,20 @@ from .prefix_cache import PagePool, RadixPrefixIndex
 from .scheduler import Request, RequestHandle, RequestResult, Scheduler
 
 __all__ = ["ServeEngine"]
+
+
+def _taped(num_on: bool, body):
+    """Trace ``body()`` (a tuple-returning program body) under a
+    declared-site numerics tape when the engine's observatory is on,
+    appending the ``{site: digest}`` dict as ONE extra program output —
+    digests ride the same dispatch and materialize with the same sync.
+    With ``num_on=False`` the body traces byte-identically to the
+    pre-observatory program (``tap`` calls inside it are identities)."""
+    if not num_on:
+        return body()
+    with numerics_tape(sites=_NUMERICS_SITES) as tape:
+        out = body()
+    return out + (tape.digests(),)
 
 
 def _cache_sharding(
@@ -385,6 +406,7 @@ class ServeEngine:
         params: Optional[dict] = None,
         finished_history: int = 1024,
         cost_cards: bool = True,
+        numerics: Optional[bool] = None,
         hbm_budget: Optional[int] = None,
         stall_timeout_s: Optional[float] = None,
         mesh: Optional[Any] = None,
@@ -615,6 +637,16 @@ class ServeEngine:
                 kv_dtype=self.kv_dtype,
             )
         self.kv_quantized = self.cache.quantized
+        # numerics observatory (ISSUE 19): digests fuse into the serve
+        # programs at trace time and ride each dispatch as one extra
+        # output — harvested ONLY at the dispatch's existing sync, so
+        # host_syncs/decode_dispatches are exactly unchanged either way
+        self.numerics = (
+            numerics_enabled() if numerics is None else bool(numerics)
+        )
+        self.numerics_book = NumericsBook()
+        self._pending_digests: list = []
+        self._kv_quant_alarmed = False
         # the dtype actually stored (model default resolved), for the
         # attributable refusal/plan naming satellite
         self.kv_dtype_name = str(self.cache.kv[0][0].dtype)
@@ -633,6 +665,8 @@ class ServeEngine:
             speculate=self.speculate or None,
             kv_cache_bytes=self.cache.nbytes,
             kv_bytes_per_token=self.cache.nbytes // _kv_rows,
+            kv_quant_err_max=0.0 if self.kv_quantized else None,
+            kv_quant_err_rms=0.0 if self.kv_quantized else None,
         )
         self._sampler = _make_slot_sampler(jnp.int32, top_k, top_p)
         # persistent mode: prefill defers its first-token fetch — the
@@ -885,6 +919,7 @@ class ServeEngine:
                 continue
             tok = int(np.asarray(pending))
             self.metrics.count("host_syncs")
+            self._harvest_numerics()
             self._record_first(req, tok, now)
             self._check_finished(req, tok, now)
         if complete:
@@ -1324,6 +1359,8 @@ class ServeEngine:
             speculate=self.speculate or None,
             kv_cache_bytes=self.cache.nbytes,
             kv_bytes_per_token=self.cache.nbytes // _kv_rows,
+            kv_quant_err_max=self.metrics.kv_quant_err_max,
+            kv_quant_err_rms=self.metrics.kv_quant_err_rms,
         )
         return self.metrics
 
@@ -1389,10 +1426,12 @@ class ServeEngine:
             )
         # kv_dtype keys the cache REPRESENTATION: an int8 engine's
         # programs carry 4-tuple carries + dequant ops and must never
-        # share (or co-count) with a plain engine's on the same model
+        # share (or co-count) with a plain engine's on the same model.
+        # numerics keys the OBSERVATORY: a digest-carrying program has
+        # one extra output and must never collide with the plain one
         return (
             self.num_slots, self.max_len, self.top_k, self.top_p,
-            self.page_size, self.kv_dtype, mesh_key,
+            self.page_size, self.kv_dtype, mesh_key, self.numerics,
         )
 
     def _out_shardings(self, n_scalar: int):
@@ -1401,24 +1440,74 @@ class ServeEngine:
         sharding, the ``n_scalar`` sampled outputs (token / ring / valid
         / cursor) come back replicated.  None when the cache has no
         NamedSharding placement — single-device programs stay exactly as
-        before."""
+        before.  With numerics on, every program carries one trailing
+        ``{site: digest}`` dict output: a single replicated leaf covers
+        it via jit's out_shardings pytree-prefix semantics."""
         if self._kv_sharding is None:
             return None
-        return (self._kv_sharding,) + (self._repl_sharding,) * n_scalar
+        n_extra = 1 if self.numerics else 0
+        return (
+            (self._kv_sharding,)
+            + (self._repl_sharding,) * (n_scalar + n_extra)
+        )
+
+    def _harvest_numerics(self) -> None:
+        """Fold every parked dispatch digest into the book — called
+        ONLY right after an existing ``host_syncs`` accounting point,
+        where the dispatch's outputs are already materialized (the
+        device_get here is a host copy of ready buffers, never a new
+        sync).  Also the drift gate: a KV dequant error above the
+        round-to-nearest bound ``s/2`` (``s`` = the max power-of-two
+        scale the scale-row digest saw) is a real quantizer invariant
+        violation and raises ONE flight anomaly per engine."""
+        if not self._pending_digests:
+            return
+        pend, self._pending_digests = self._pending_digests, []
+        try:
+            for tree in jax.device_get(pend):
+                self.numerics_book.update_tree(tree)
+            book = self.numerics_book
+            err = book.digest("kv_quant_err")
+            if err is not None and err.count:
+                self.metrics.observe_kv_quant(err.max_abs, err.rms)
+                sc = book.digest("kv_quant_scale")
+                bound = 0.5 * sc.max_abs if sc is not None else None
+                if (
+                    bound
+                    and err.max_abs > bound * (1.0 + 1e-6)
+                    and not self._kv_quant_alarmed
+                ):
+                    self._kv_quant_alarmed = True
+                    from ..obs.flight import get_flight_recorder
+
+                    get_flight_recorder().record(
+                        "anomaly",
+                        anomaly="kv_quant_err",
+                        err_max=float(err.max_abs),
+                        bound=float(bound),
+                    )
+            book.emit_counter_tracks(get_tracer())
+        except Exception:  # pragma: no cover - telemetry must not kill
+            pass  # serving; a failed harvest loses a window, not a run
 
     def _prefill_program(self, bucket: int):
         model, sampler = self.model, self._sampler
+        num_on = self.numerics
 
         def build(params, kv, tokens, true_len, slot, temp, seed):
-            slab = model.init_cache(1, bucket)
-            logits, slab = functional_call(
-                model, params, (tokens, slab, 0), method="forward_cached"
-            )
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1
-            )[:, 0, :]
-            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
-            return write_slot(kv, slab, slot), tok[0]
+            def body():
+                slab = model.init_cache(1, bucket)
+                logits, slab = functional_call(
+                    model, params, (tokens, slab, 0),
+                    method="forward_cached",
+                )
+                last = tap("logits", jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1
+                )[:, 0, :])
+                tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+                return write_slot(kv, slab, slot), tok[0]
+
+            return _taped(num_on, body)
 
         # the kv slab is donated: self.cache.kv is rebound to the output
         # immediately, so the input buffer is dead — without aliasing,
@@ -1451,35 +1540,39 @@ class ServeEngine:
         (it is the request's first token, sampler step 0 — identical to
         the unchunked program's); intermediate chunks discard it."""
         model, sampler, max_len = self.model, self._sampler, self.max_len
+        num_on = self.numerics
 
         def build(params, kv, tokens, cache_pos, true_len, slot, temp, seed):
-            def row(c):
-                return jax.lax.dynamic_slice(
-                    c, (slot, 0, 0, 0), (1, max_len) + c.shape[2:]
-                )
+            def body():
+                def row(c):
+                    return jax.lax.dynamic_slice(
+                        c, (slot, 0, 0, 0), (1, max_len) + c.shape[2:]
+                    )
 
-            # quantized caches: slice data + scale rows, hand the model a
-            # dequantized pair view; write_slot requantizes on the way
-            # back (bit-stable for untouched rows — power-of-two scales,
-            # serve/kv_cache.py)
-            view = [
-                (
-                    (dequantize_kv(row(e[0]), row(e[2])),
-                     dequantize_kv(row(e[1]), row(e[3])))
-                    if len(e) == 4
-                    else (row(e[0]), row(e[1]))
+                # quantized caches: slice data + scale rows, hand the
+                # model a dequantized pair view; write_slot requantizes
+                # on the way back (bit-stable for untouched rows —
+                # power-of-two scales, serve/kv_cache.py)
+                view = [
+                    (
+                        (dequantize_kv(row(e[0]), row(e[2])),
+                         dequantize_kv(row(e[1]), row(e[3])))
+                        if len(e) == 4
+                        else (row(e[0]), row(e[1]))
+                    )
+                    for e in kv
+                ]
+                logits, view = functional_call(
+                    model, params, (tokens, view, cache_pos),
+                    method="forward_cached",
                 )
-                for e in kv
-            ]
-            logits, view = functional_call(
-                model, params, (tokens, view, cache_pos),
-                method="forward_cached",
-            )
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1
-            )[:, 0, :]
-            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
-            return write_slot(kv, view, slot), tok[0]
+                last = tap("logits", jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1
+                )[:, 0, :])
+                tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+                return write_slot(kv, view, slot), tok[0]
+
+            return _taped(num_on, body)
 
         return _cached_jit(
             model,
@@ -1506,34 +1599,44 @@ class ServeEngine:
         the scratch page, where nothing ever reads them.
         """
         model, sampler, ps = self.model, self._sampler, self.page_size
+        num_on = self.numerics
 
         def build_warm(params, kv, pt_row, tokens, pfx_len, true_len,
                        temp, seed):
-            view = paged_view(kv, pt_row, ps)
-            logits, view = functional_call(
-                model, params, (tokens, view, pfx_len),
-                method="forward_cached",
-            )
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1
-            )[:, 0, :]
-            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
-            kv = paged_scatter_rows(kv, view, pt_row, ps, pfx_len, bucket)
-            return kv, tok[0]
+            def body():
+                view = paged_view(kv, pt_row, ps)
+                logits, view = functional_call(
+                    model, params, (tokens, view, pfx_len),
+                    method="forward_cached",
+                )
+                last = tap("logits", jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1
+                )[:, 0, :])
+                tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+                out = paged_scatter_rows(
+                    kv, view, pt_row, ps, pfx_len, bucket
+                )
+                return out, tok[0]
+
+            return _taped(num_on, body)
 
         def build_cold(params, kv, pt_row, tokens, true_len, temp, seed):
-            view = paged_view(kv, pt_row, ps)
-            logits, view = functional_call(
-                model, params, (tokens, view, 0), method="forward_cached"
-            )
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1
-            )[:, 0, :]
-            tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
-            kv = paged_scatter_rows(
-                kv, view, pt_row, ps, jnp.int32(0), bucket
-            )
-            return kv, tok[0]
+            def body():
+                view = paged_view(kv, pt_row, ps)
+                logits, view = functional_call(
+                    model, params, (tokens, view, 0),
+                    method="forward_cached",
+                )
+                last = tap("logits", jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1
+                )[:, 0, :])
+                tok = sampler(last, temp, seed, jnp.zeros((1,), jnp.int32))
+                out = paged_scatter_rows(
+                    kv, view, pt_row, ps, jnp.int32(0), bucket
+                )
+                return out, tok[0]
+
+            return _taped(num_on, body)
 
         # pools donated like the slab (engine rebinds before the sync)
         return _cached_jit(
@@ -1560,6 +1663,7 @@ class ServeEngine:
             eos_token=self.eos_token,
             max_len=self.max_len,
             decode_chunk=self.decode_chunk,
+            numerics=self.numerics,
         )
         return _cached_jit(
             self.model,
@@ -1590,6 +1694,7 @@ class ServeEngine:
                     max_len=self.max_len,
                     ring_capacity=self.ring_capacity,
                     stream_cb=self._stream_cb,
+                    numerics=self.numerics,
                 )
                 kwargs = {}
                 if self._out_shardings(3) is not None:
@@ -1605,6 +1710,7 @@ class ServeEngine:
             max_len=self.max_len,
             ring_capacity=self.ring_capacity,
             stream_cb=None,
+            numerics=self.numerics,
         )
         return _cached_jit(
             self.model,
@@ -1631,6 +1737,7 @@ class ServeEngine:
             decode_chunk=self.decode_chunk,
             speculate=self.speculate,
             ngram=self.spec_ngram,
+            numerics=self.numerics,
         )
         return _cached_jit(
             self.model,
@@ -1658,6 +1765,7 @@ class ServeEngine:
             ring_capacity=self.ring_capacity,
             speculate=self.speculate,
             ngram=self.spec_ngram,
+            numerics=self.numerics,
         )
         return _cached_jit(
             self.model,
@@ -1927,6 +2035,7 @@ class ServeEngine:
             self._pending_first[slot] = tok
             return
         self.metrics.count("host_syncs")  # the dispatch's token fetch
+        self._harvest_numerics()
         self._record_first(req, tok, now)
         self._check_finished(req, tok, now)
 
@@ -1976,11 +2085,14 @@ class ServeEngine:
         with timed_annotation(
             "serve/prefill", self.metrics.prefill_s.record
         ), self._watch(name):
-            kv, tok = program(*args)
+            out = program(*args)
+            kv, tok = out[0], out[1]
             # rebind BEFORE the host sync: the dispatch donated the old
             # slab, so if the sync raises (wedged relay) the engine must
             # already hold the live output, not a deleted buffer
             self.cache.kv = kv
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             if not self._persistent:  # persistent defers to the drain
                 tok = int(np.asarray(tok))  # host sync: first token exists
         self.metrics.count("tokens_prefilled", bucket)
@@ -2051,8 +2163,11 @@ class ServeEngine:
             with timed_annotation(
                 "serve/prefill", self.metrics.prefill_s.record
             ), self._watch(name):
-                kv, tok = program(*args)
+                out = program(*args)
+                kv, tok = out[0], out[1]
                 self.cache.kv = kv  # before any sync: slab was donated
+                if self.numerics:
+                    self._pending_digests.append(out[-1])
                 if i == len(chunks) - 1 and not self._persistent:
                     tok = int(np.asarray(tok))  # host sync: first token
             self.metrics.count("tokens_prefilled", bucket)
@@ -2100,8 +2215,11 @@ class ServeEngine:
         with timed_annotation(
             "serve/prefill", self.metrics.prefill_s.record
         ), self._watch(name):
-            kv, tok = program(*args)
+            out = program(*args)
+            kv, tok = out[0], out[1]
             self.cache.kv = kv  # before the sync: the pools were donated
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             if not self._persistent:  # persistent defers to the drain
                 tok = int(np.asarray(tok))
         # only the suffix bucket was computed — the prefix hit is the
@@ -2182,8 +2300,11 @@ class ServeEngine:
             with timed_annotation(
                 "serve/prefill", self.metrics.prefill_s.record
             ), self._watch(name):
-                kv, tok = program(*args)
+                out = program(*args)
+                kv, tok = out[0], out[1]
                 self.cache.kv = kv  # before any sync: pools were donated
+                if self.numerics:
+                    self._pending_digests.append(out[-1])
                 if i == len(chunks) - 1 and not self._persistent:
                     tok = int(np.asarray(tok))
             self.metrics.count("tokens_prefilled", bucket)
@@ -2231,10 +2352,14 @@ class ServeEngine:
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
         ) as timing, self._watch(name):
-            kv, block = program(*args)
+            out = program(*args)
+            kv, block = out[0], out[1]
             self.cache.kv = kv  # before the sync: old slab was donated
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             block = np.asarray(block)  # ONE host sync per K slot-steps
         self.metrics.count("host_syncs")
+        self._harvest_numerics()
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", k_steps)
         self._record_tp_collectives(self.num_slots, k_steps)
@@ -2324,8 +2449,11 @@ class ServeEngine:
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
         ) as timing, self._watch(name):
-            kv, ring, valid, iters = program(*args)
+            out = program(*args)
+            kv, ring, valid, iters = out[0], out[1], out[2], out[3]
             self.cache.kv = kv  # before the sync: old slab was donated
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             # ONE host sync drains the ring, the valid mask, the cursor,
             # and every pending first token together
             block, vmask, n_it, firsts = jax.device_get(
@@ -2334,6 +2462,7 @@ class ServeEngine:
         n_it = int(n_it)
         self._pending_first.clear()
         self.metrics.count("host_syncs")  # the drain IS the sync
+        self._harvest_numerics()
         self.metrics.count("ring_drains")
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", n_it)
@@ -2466,11 +2595,15 @@ class ServeEngine:
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
         ) as timing, self._watch(name):
-            kv, ys, cs = program(*args)
+            out = program(*args)
+            kv, ys, cs = out[0], out[1], out[2]
             self.cache.kv = kv  # before the sync: old slab was donated
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             # ONE host sync for the blocks and the counts together
             ys, cs = jax.device_get((ys, cs))
         self.metrics.count("host_syncs")
+        self._harvest_numerics()
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", k_steps)
         self._record_tp_collectives(
@@ -2551,8 +2684,11 @@ class ServeEngine:
         with timed_annotation(
             "serve/decode", self.metrics.decode_s.record
         ) as timing, self._watch(name):
-            kv, ring, cnts, iters = program(*args)
+            out = program(*args)
+            kv, ring, cnts, iters = out[0], out[1], out[2], out[3]
             self.cache.kv = kv  # before the sync: old slab was donated
+            if self.numerics:
+                self._pending_digests.append(out[-1])
             # ONE host sync drains the block ring, the count ring, the
             # cursor, and every pending first token together
             block, cmat, n_it, firsts = jax.device_get(
@@ -2561,6 +2697,7 @@ class ServeEngine:
         n_it = int(n_it)
         self._pending_first.clear()
         self.metrics.count("host_syncs")  # the drain IS the sync
+        self._harvest_numerics()
         self.metrics.count("ring_drains")
         self.metrics.count("decode_dispatches")
         self.metrics.count("decode_steps", n_it)
@@ -2639,6 +2776,7 @@ class ServeEngine:
             # engine would have returned, at the cost of one sync
             tok = int(np.asarray(pending))
             self.metrics.count("host_syncs")
+            self._harvest_numerics()
             self._record_first(req, tok, now)
         self.scheduler.retire(req)
         self.cache.retire(slot)  # paged: also rewires the table to scratch

@@ -75,6 +75,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.numerics import tap, tap_error
 from .prefix_cache import SCRATCH_PAGE
 
 __all__ = [
@@ -164,6 +165,16 @@ def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
     return q.astype(jnp.float32) * scale
 
 
+def _tap_quant(orig: jax.Array, q: jax.Array, scale: jax.Array) -> None:
+    """Numerics-observatory probe at a quantize-on-write site: digest of
+    the dequantization error ``orig - q*scale`` plus the scale rows
+    themselves (``max_abs`` of the scale digest is the ``s`` in the
+    round-to-nearest bound ``|err| <= s/2``).  Identity without an
+    active tape — the default serve programs trace byte-identically."""
+    tap_error("kv_quant_err", orig, dequantize_kv(q, scale))
+    tap("kv_quant_scale", scale)
+
+
 def quantize_cache(kv: Any) -> Any:
     """Pairs → per-layer ``(k, v, k_scale, v_scale)`` 4-tuples."""
     out: List[tuple] = []
@@ -204,6 +215,8 @@ def write_slot(kv: Any, slab: Any, slot) -> Any:
             ck, cv, cks, cvs = entry
             qk, ssk = quantize_kv(sk)
             qv, ssv = quantize_kv(sv)
+            _tap_quant(sk, qk, ssk)
+            _tap_quant(sv, qv, ssv)
             out.append(
                 (
                     lax.dynamic_update_slice(ck, qk, (slot, 0, 0, 0)),
@@ -275,8 +288,11 @@ def paged_scatter_rows(
         seg_v = lax.dynamic_slice_in_dim(wv[0], start, length, axis=0)
         if len(entry) == 4:
             ks, vs = entry[2], entry[3]
-            seg_k, seg_ks = quantize_kv(seg_k)
-            seg_v, seg_vs = quantize_kv(seg_v)
+            seg_qk, seg_ks = quantize_kv(seg_k)
+            seg_qv, seg_vs = quantize_kv(seg_v)
+            _tap_quant(seg_k, seg_qk, seg_ks)
+            _tap_quant(seg_v, seg_qv, seg_vs)
+            seg_k, seg_v = seg_qk, seg_qv
             fks = ks.reshape(-1, *ks.shape[2:]).at[rows].set(seg_ks)
             fvs = vs.reshape(-1, *vs.shape[2:]).at[rows].set(seg_vs)
             fk = k.reshape(-1, *k.shape[2:]).at[rows].set(seg_k)

@@ -154,8 +154,12 @@ class ServeMetrics:
     know their KV pool footprint add ``kv_cache_bytes`` (total resident
     KV bytes, quantization scales included) and ``kv_bytes_per_token``
     (pool bytes per cache token-row — int8 caches publish roughly half
-    the bf16 figure).  All config gauges survive ``reset_metrics()``:
-    the engine re-passes them when it rebuilds this object.
+    the bf16 figure); quantized (int8) engines additionally publish
+    ``kv_quant_err_max`` / ``kv_quant_err_rms`` (observed KV dequant
+    error from the numerics-observatory digests; the max is pinned
+    ``<= s/2`` by the power-of-two quantizer's round-to-nearest bound).
+    All config gauges survive ``reset_metrics()``: the engine re-passes
+    them when it rebuilds this object.
     Histograms: ``ttft_s`` (submit -> first token on host),
     ``e2e_latency_s``, ``queue_wait_s``, ``tpot_s`` (per finished
     request: decode seconds per token after the first — the
@@ -193,6 +197,8 @@ class ServeMetrics:
         speculate: Optional[int] = None,
         kv_cache_bytes: Optional[int] = None,
         kv_bytes_per_token: Optional[int] = None,
+        kv_quant_err_max: Optional[float] = None,
+        kv_quant_err_rms: Optional[float] = None,
     ):
         self.num_slots = int(num_slots)
         self.num_pages = num_pages if num_pages is None else int(num_pages)
@@ -211,6 +217,23 @@ class ServeMetrics:
             kv_bytes_per_token
             if kv_bytes_per_token is None
             else int(kv_bytes_per_token)
+        )
+        # KV dequantization-error gauges (int8 pools only; ISSUE 19):
+        # observed max |orig - deq| and its RMS across every
+        # quantize-on-write site, harvested from the numerics-observatory
+        # digests at existing sync points.  Bounded by s/2 (power-of-two
+        # scales, round-to-nearest) — tests/test_kv_quant.py pins the
+        # bound.  Like the footprint gauges these survive
+        # ``reset_metrics()``: the engine re-passes the current values.
+        self.kv_quant_err_max = (
+            kv_quant_err_max
+            if kv_quant_err_max is None
+            else float(kv_quant_err_max)
+        )
+        self.kv_quant_err_rms = (
+            kv_quant_err_rms
+            if kv_quant_err_rms is None
+            else float(kv_quant_err_rms)
         )
         self.started_at = time.monotonic()
         self.counters: Dict[str, int] = {
@@ -287,6 +310,16 @@ class ServeMetrics:
         mark lives on this metrics object, not the engine."""
         self.ring_occupancy_hwm = max(self.ring_occupancy_hwm, iterations)
 
+    def observe_kv_quant(self, err_max: float, err_rms: float) -> None:
+        """Quantized engines only: fold one numerics-harvest window's KV
+        dequant error into the gauges — running max for the bound check,
+        latest-window RMS for the trend line."""
+        prev = self.kv_quant_err_max
+        self.kv_quant_err_max = (
+            float(err_max) if prev is None else max(prev, float(err_max))
+        )
+        self.kv_quant_err_rms = float(err_rms)
+
     def to_json(self) -> dict:
         """The one structured, JSON-serializable schema tests, bench, and
         CI all parse: ``{"counters", "gauges", "histograms", "derived"}``
@@ -319,6 +352,10 @@ class ServeMetrics:
             gauges["kv_cache_bytes"] = self.kv_cache_bytes
         if self.kv_bytes_per_token is not None:
             gauges["kv_bytes_per_token"] = self.kv_bytes_per_token
+        if self.kv_quant_err_max is not None:
+            gauges["kv_quant_err_max"] = self.kv_quant_err_max
+        if self.kv_quant_err_rms is not None:
+            gauges["kv_quant_err_rms"] = self.kv_quant_err_rms
         wall = time.monotonic() - self.started_at
         # decode-only tokens over decode-only time: prefill's sampled
         # token rides a prefill dispatch, so counting it here would

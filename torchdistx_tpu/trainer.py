@@ -132,6 +132,16 @@ class Trainer:
 
         self._want_cost_card = bool(cost_card) and not _cost_force_disabled()
         self.cost_card = None
+        # numerics observatory (obs.numerics): when the step fuses
+        # digests (ShardedTrainStep/GSPMDTrainStep numerics=... /
+        # TDX_NUMERICS), they are harvested HERE, at the log boundary's
+        # existing block_until_ready — the arrays are already resident,
+        # so the device_get is a copy, not a new sync.  The book feeds
+        # nonfinite provenance into failure/rollback flight records,
+        # Perfetto counter tracks, and numerics_collector().
+        from .obs.numerics import NumericsBook
+
+        self.numerics_book = NumericsBook()
         # dispatch-stall watchdog (obs.watchdog): armed around every
         # step dispatch and log-boundary device sync — a wedged step
         # dumps the flight ring naming "trainer/step" + its cost card
@@ -439,6 +449,27 @@ class Trainer:
         except Exception:
             self.cost_card = None
 
+    def _harvest_numerics(self) -> None:
+        """Fold the step's fused digests (if any) into the numerics book.
+
+        Called only at log boundaries, immediately after the existing
+        ``block_until_ready(loss)`` — the digest arrays rode the same
+        program as the loss, so they are already materialized and the
+        ``device_get`` here is a host copy, never a new device sync or
+        dispatch (the ISSUE 19 zero-sync contract)."""
+        digs = getattr(self.step, "last_digests", None)
+        if digs is None:
+            return
+        try:
+            self.numerics_book.update_tree(
+                jax.device_get(digs), step=self.global_step
+            )
+            self.numerics_book.emit_counter_tracks(get_tracer())
+        except Exception:
+            # telemetry must never kill the loop (same discipline as
+            # _safe_dump); a malformed digest just goes unharvested
+            pass
+
     def _update_derived_metrics(self) -> None:
         """goodput / tokens-per-sec / mfu gauges from the accumulated
         wall-time split; cheap, host-only."""
@@ -534,6 +565,7 @@ class Trainer:
                     jax.block_until_ready(loss)
                 dt = time.time() - t_window
                 last_loss = float(loss)
+                self._harvest_numerics()
                 if self.failure_detector is not None:
                     from .utils.failure import StepFailure, apply_failure_policy
 
@@ -563,6 +595,13 @@ class Trainer:
                             failure_kind=failure.kind,
                             loss=last_loss,
                             last_checkpoint=self._last_checkpoint,
+                            # numerics provenance: the EARLIEST tap site
+                            # (program order) whose nonfinite count went
+                            # positive — names the layer a NaN was born
+                            # in, not just the loss that surfaced it
+                            nonfinite_site=(
+                                self.numerics_book.first_nonfinite_site()
+                            ),
                         )
                         t_rb = time.time()
                         rs0 = self._t_reshard
@@ -584,6 +623,9 @@ class Trainer:
                             restored_step=self.global_step,
                             checkpoint=self._last_checkpoint,
                             seconds=round(time.time() - t_rb, 3),
+                            nonfinite_site=(
+                                self.numerics_book.first_nonfinite_site()
+                            ),
                         )
                         # the dump IS the incident artifact: write it even
                         # though the run continues (ISSUE 5 crash-path
@@ -717,6 +759,9 @@ class Trainer:
                             m[name]
                         )
                     )
+            book = self.numerics_book
+            if book is not None and book.harvests:
+                fams.extend(book.collector(prefix=f"{prefix}_numerics")())
             det = self.failure_detector
             if det is not None:
                 fams.append(

@@ -213,11 +213,27 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
                 functional_call(model, p, (tokens,)), labels
             )
 
+    # numerics observatory (obs/numerics.py): under TDX_NUMERICS=1 the
+    # scanned step also emits per-group digests (params / loss / grads),
+    # reduced across steps INSIDE the same jitted program — the bench
+    # record embeds them with zero extra dispatches, same discipline as
+    # the serve engine.  aux becomes (losses, digests).
+    from ..obs.numerics import numerics_enabled
+
+    num_on = numerics_enabled()
+
     def step(carry, _):
         p, s = carry
         loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, s = tx.update(grads, s, p)
         p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        if num_on:
+            from ..obs.numerics import array_digest, tree_group_digest
+
+            digs = tree_group_digest(p, "params/")
+            digs["loss"] = array_digest(loss)
+            digs.update(tree_group_digest(grads, "grads/"))
+            return (p, s), (loss, digs)
         return (p, s), loss
 
     # N steps in ONE jitted lax.scan: per-call dispatch through the axon
@@ -239,6 +255,13 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
         jax.jit, donate_argnums=(0,), out_shardings=(carry_sh, None)
     )
     def run(carry):
+        if num_on:
+            from ..obs.numerics import reduce_stacked_digests
+
+            carry, (losses, stacked) = lax.scan(
+                step, carry, None, length=n_steps
+            )
+            return carry, (losses, reduce_stacked_digests(stacked))
         return lax.scan(step, carry, None, length=n_steps)
 
     # model FLOPs per token: 6N for fwd+bwd matmuls + attention term
@@ -257,6 +280,7 @@ def build_train_workload(n_steps: int) -> dict[str, Any]:
         "optimizer": opt_label,
         "fused_ce": fused_ce,
         "zero2": zero2,
+        "numerics": num_on,
     }
     if plan is not None:
 
