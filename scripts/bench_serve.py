@@ -65,6 +65,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -274,6 +275,17 @@ def _parse_args():
         "'numerics_site') that perf_gate pins bit-identically across "
         "runs.  Default phases never build digest engines, so "
         "pre-existing fingerprints stay byte-stable",
+    )
+    ap.add_argument(
+        "--record",
+        action="store_true",
+        help="incident time machine (ISSUE 20): after each phase's "
+        "measured window, re-serve the identical workload on a fresh "
+        "engine with session recording on (obs/blackbox.py tdx-session-v1 "
+        "black box), then self-replay the recording and embed the STRICT "
+        "verdict — every drain-boundary digest chain must be "
+        "bit-identical, and the recording engine's counters must equal "
+        "the unrecorded measured run's (the zero-overhead pin)",
     )
     ap.add_argument(
         "--artifact",
@@ -966,6 +978,158 @@ def _build_model(name: str, plat):
     return model
 
 
+def _session_selftest(
+    args, record, model, name, plat, engine_kw, work, tag
+) -> None:
+    """``--record``: the phase's incident-time-machine leg.  Re-serves
+    the phase's measured workload on a FRESH engine with session
+    recording on (a fresh engine because recording must start at
+    construction — mid-run ``reset_metrics`` would fold negative
+    counter deltas), writes the ``tdx-session-v1`` black box, then
+    self-replays it in-process and embeds the verdict.  STRICT: a
+    non-match verdict is a phase ``error``.  The recording engine's
+    counters are compared against the unrecorded measured run's — the
+    zero-overhead evidence (recording adds no host syncs, no
+    dispatches, nothing countable).
+
+    Call AFTER ``record['recompile_measure']`` and ``_dump_obs`` so
+    this leg's compiles never pollute the measured compile count."""
+    if not getattr(args, "record", False):
+        return
+    from torchdistx_tpu.obs.blackbox import (
+        geometry_kwargs,
+        load_session,
+        replay_session,
+    )
+    from torchdistx_tpu.serve import ServeEngine
+
+    rec, path = _session_recorder(args, name, plat, tag)
+    engine = ServeEngine(model, record=rec, **engine_kw)
+    engine.run([dict(w) for w in work])
+    rec.close()
+
+    events, _notes = load_session(path)
+
+    def engine_factory(rep_rec, geom):
+        # recorded geometry wins; non-geometry extras (mesh, numerics)
+        # come from the phase's own kwargs
+        return ServeEngine(
+            model, record=rep_rec, **{**engine_kw, **geometry_kwargs(geom)}
+        )
+
+    verdict = replay_session(events, engine_factory=engine_factory)
+
+    counters = {
+        k: v
+        for k, v in engine.metrics.counters.items()
+        if isinstance(v, int)
+    }
+    _embed_session_verdict(record, path, verdict, counters)
+
+
+def _session_recorder(args, name, plat, tag):
+    """The selftest recording sink: one ``tdx-session-v1`` file per
+    phase under ``TDX_SERVE_TRACE_DIR`` (tmpdir fallback), seeded with
+    the ``model_spec`` event ``scripts/replay_session.py`` rebuilds
+    the model from."""
+    from torchdistx_tpu.obs.blackbox import SessionRecorder
+
+    out_dir = os.environ.get("TDX_SERVE_TRACE_DIR") or tempfile.gettempdir()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"session_{tag}_{os.getpid()}.jsonl")
+    if os.path.exists(path):
+        os.remove(path)
+    rec = SessionRecorder(path, enabled=True)
+    rec.record(
+        "model_spec",
+        name=name,
+        seed=0,
+        dtype="bfloat16" if plat != "cpu" else "float32",
+    )
+    return rec, path
+
+
+def _embed_session_verdict(record, path, verdict, counters) -> None:
+    """Embed the self-replay verdict + the zero-overhead counter pin
+    in the phase record; STRICT turns either failure into the phase
+    ``error``."""
+    measured = ((record.get("metrics") or {}).get("counters")) or {}
+    unequal = {
+        k: (counters.get(k), measured.get(k))
+        for k in sorted(counters)
+        if counters.get(k) != measured.get(k)
+    }
+    record["session"] = {
+        "path": path,
+        "drains": verdict.get("drains_recorded"),
+        "verdict": verdict.get("verdict"),
+        "match": bool(verdict.get("match")),
+        "first_divergence": verdict.get("first_divergence"),
+        "counters_equal": not unequal,
+        "counters_unequal": unequal,
+    }
+    if not verdict.get("match") and "error" not in record:
+        d = verdict.get("first_divergence") or {}
+        record["error"] = (
+            f"session replay {verdict.get('verdict')}: first divergence "
+            f"at drain seq={d.get('seq')} tick={d.get('tick')} "
+            f"counters={d.get('counters')} rids={d.get('rids')}"
+        )
+    elif unequal and "error" not in record:
+        record["error"] = (
+            "session recording moved engine counters vs the unrecorded "
+            f"measured run (recorded, measured): {unequal}"
+        )
+
+
+def _session_selftest_fleet(
+    args, record, model, name, plat, build, work, tag, *, policy="affinity"
+) -> None:
+    """``--record``, fleet posture: re-drives the phase's workload
+    through a FRESH recording fleet (same online arrival, same policy
+    as the measured affinity side), writes the ``tdx-session-v1`` black
+    box with the FLEET as the driver (per-replica geometry, routing
+    ticks), then self-replays it from the recording alone — each
+    replica rebuilt from ITS geometry event, the shared model from
+    ``model_spec``.  Same STRICT verdict and zero-overhead counter pin
+    as the single-engine selftest, against the fleet's summed
+    aggregate."""
+    if not getattr(args, "record", False):
+        return
+    from torchdistx_tpu.obs.blackbox import (
+        geometry_kwargs,
+        load_session,
+        replay_session,
+    )
+    from torchdistx_tpu.serve import ServeEngine, ServeFleet
+
+    rec, path = _session_recorder(args, name, plat, tag)
+    fleet = ServeFleet(
+        [build() for _ in range(int(args.fleet))],
+        policy=policy,
+        record=rec,
+    )
+    for w in work:  # online arrival, like the measured A/B
+        fleet.submit(**dict(w))
+        fleet.step()
+    while fleet.step():
+        pass
+    rec.close()
+
+    events, _notes = load_session(path)
+
+    def engine_factory(rep_rec, geom):
+        return ServeEngine(model, record=rep_rec, **geometry_kwargs(geom))
+
+    verdict = replay_session(events, engine_factory=engine_factory)
+    counters = {
+        k: v
+        for k, v in fleet.metrics_json()["counters"].items()
+        if isinstance(v, int)
+    }
+    _embed_session_verdict(record, path, verdict, counters)
+
+
 def _child(args) -> None:
     """One phase: one engine at one decode_chunk (or the persistent
     loop), warm then measure."""
@@ -1040,19 +1204,18 @@ def _child(args) -> None:
 
         from torchdistx_tpu.obs.comm import comm_audit
 
+        work = [
+            {
+                "prompt": p,
+                "max_new_tokens": args.max_new,
+                "temperature": args.temperature,
+                "seed": i,
+            }
+            for i, p in enumerate(prompts)
+        ]
         t0 = time.perf_counter()
         with comm_audit() as comm_prof:
-            results = engine.run(
-                [
-                    {
-                        "prompt": p,
-                        "max_new_tokens": args.max_new,
-                        "temperature": args.temperature,
-                        "seed": i,
-                    }
-                    for i, p in enumerate(prompts)
-                ]
-            )
+            results = engine.run([dict(w) for w in work])
         wall = time.perf_counter() - t0
 
         # per-phase collective traffic (tdx-comm-v1): the engine's
@@ -1071,8 +1234,23 @@ def _child(args) -> None:
             finish_reasons=sorted({r.finish_reason for r in results}),
             kv_cache_gb=round(engine.cache.nbytes / 1e9, 3),
         )
-        _dump_obs(
-            record, engine, "persistent" if persistent else f"k{k_chunk}"
+        tag = "persistent" if persistent else f"k{k_chunk}"
+        _dump_obs(record, engine, tag)
+        _session_selftest(
+            args,
+            record,
+            model,
+            name,
+            plat,
+            dict(
+                num_slots=args.slots,
+                max_len=max_len,
+                **engine_kw,
+                **_mesh_kwargs(args),
+                **_kv_kwargs(args),
+            ),
+            work,
+            tag,
         )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
@@ -1158,19 +1336,18 @@ def _child_spec(args) -> None:
 
         from torchdistx_tpu.obs.comm import comm_audit
 
+        work = [
+            {
+                "prompt": p,
+                "max_new_tokens": spec_new,
+                "temperature": args.temperature,
+                "seed": i,
+            }
+            for i, p in enumerate(prompts)
+        ]
         t0 = time.perf_counter()
         with comm_audit() as comm_prof:
-            results = engine.run(
-                [
-                    {
-                        "prompt": p,
-                        "max_new_tokens": spec_new,
-                        "temperature": args.temperature,
-                        "seed": i,
-                    }
-                    for i, p in enumerate(prompts)
-                ]
-            )
+            results = engine.run([dict(w) for w in work])
         wall = time.perf_counter() - t0
 
         record["comm"] = comm_prof.to_json()
@@ -1197,6 +1374,22 @@ def _child_spec(args) -> None:
                 f"(accepted_tokens_per_iteration={atpi})"
             )
         _dump_obs(record, engine, f"spec{spec_k}")
+        _session_selftest(
+            args,
+            record,
+            model,
+            name,
+            plat,
+            dict(
+                num_slots=args.slots,
+                max_len=max_len,
+                **engine_kw,
+                **_mesh_kwargs(args),
+                **_kv_kwargs(args),
+            ),
+            work,
+            f"spec{spec_k}",
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -1824,6 +2017,25 @@ def _child_kv_quant(args) -> None:
                 f"as its bfloat16 twin: {worst}"
             )
         _dump_obs(record, quant, "kv_quant")
+        # record + self-replay the QUANTIZED leg (the one the verdict
+        # rides on); record["metrics"] is the quant leg's counters, so
+        # the zero-overhead comparison lines up
+        _session_selftest(
+            args,
+            record,
+            model,
+            name,
+            plat,
+            dict(
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                kv_dtype=kv_dtype,
+                **_mesh_kwargs(args),
+            ),
+            work,
+            "kv_quant",
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -1945,6 +2157,27 @@ def _child_numerics(args) -> None:
                 f"{book.first_nonfinite_site()}"
             )
         _dump_obs(record, on, "numerics")
+        # record + self-replay the digest-ON leg; numerics is not a
+        # geometry field (digests are counter-neutral by ISSUE 19's
+        # contract), so the replay engine rebuilds digest-on via the
+        # phase kwargs and must still chain bit-identically
+        _session_selftest(
+            args,
+            record,
+            model,
+            name,
+            plat,
+            dict(
+                num_slots=args.slots,
+                max_len=max_len,
+                decode_chunk=k_chunk,
+                numerics=True,
+                **_mesh_kwargs(args),
+                **_kv_kwargs(args),
+            ),
+            work,
+            "numerics",
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))
@@ -2215,6 +2448,9 @@ def _child_fleet(args) -> None:
             )
         _maybe_slo_error(args, record)
         _dump_obs_fleet(record, fleet_aff, "fleet", slo_spec=_slo_spec(args))
+        _session_selftest_fleet(
+            args, record, model, name, plat, build, work, "fleet"
+        )
     except Exception as e:  # degraded-but-parseable, bench.py contract
         record["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(record))

@@ -71,6 +71,15 @@ Usage:
     committed ``expectations/static_analysis_baseline.json``; checks
     field types, TDXnnn rule ids, severities, and that every recorded
     suppression carries justification text)
+  python scripts/check_obs_artifacts.py --session SESSION.jsonl
+    (session black-box validation — the incident time machine's
+    integrity gate: ``tdx-session-v1`` schema, header stamped, drain
+    seqs dense from 0, the SHA-256 digest chain recomputable from the
+    drain payloads, every periodic snapshot anchored to its drain with
+    counters equal to the accumulated deltas, and a ``session_end``
+    whose chain/count match; --allow-truncated downgrades a missing
+    session_end — the killed-run case — to a note, since the complete
+    prefix still replays via scripts/replay_session.py)
   Flight validation accepts --expect-slo-burn alongside
   --expect-rollback: the record must then contain an ``slo_burn``
   entry naming the breached objective (the injected-burn CI leg's
@@ -699,6 +708,42 @@ def _check_autoscale_main(paths: list) -> None:
     )
 
 
+def _check_session_main(argv: list) -> None:
+    from torchdistx_tpu.obs.blackbox import validate_session_jsonl
+
+    allow_truncated = "--allow-truncated" in argv
+    unknown = [
+        a
+        for a in argv
+        if a.startswith("--") and a != "--allow-truncated"
+    ]
+    if unknown:
+        # a typoed flag must NOT silently weaken the gate (the --flight
+        # discipline)
+        raise SystemExit(f"unknown flag(s) {unknown}\n\n{__doc__}")
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        raise SystemExit(__doc__)
+    errors: list = []
+    for p in paths:
+        errs = validate_session_jsonl(p, allow_truncated=allow_truncated)
+        errors.extend(errs)
+        if not errs:
+            with open(p) as f:
+                lines = [ln for ln in f if ln.strip()]
+            drains = sum(
+                1
+                for ln in lines
+                if '"kind": "drain"' in ln or '"kind":"drain"' in ln
+            )
+            print(f"session {p}: {len(lines)} events, {drains} drains")
+    if errors:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"session black box OK ({len(paths)} file(s))")
+
+
 def _check_lint_main(paths: list) -> None:
     from torchdistx_tpu.analysis import validate_lint_report
 
@@ -745,6 +790,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--autoscale":
         _check_autoscale_main(sys.argv[2:])
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--session":
+        _check_session_main(sys.argv[2:])
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--lint":
         _check_lint_main(sys.argv[2:])

@@ -1,0 +1,199 @@
+"""Incident time machine CLI — replay a ``tdx-session-v1`` black box.
+
+Takes one recording written by a ``ServeEngine(record=...)`` /
+``ServeFleet(record=...)`` session (``obs/blackbox.py``), rebuilds the
+engine/fleet from the recorded geometry, re-drives the exact submit/
+step/tick/signal stream on this host's mesh (CPU by default — the CI
+posture), and prints the verdict:
+
+- ``match`` — every drain-boundary digest is bit-identical: the
+  incident reproduces deterministically and can be debugged offline.
+- ``truncated_match`` — the recording ends without a ``session_end``
+  (killed run); the complete prefix replays bit-identically and the
+  truncation point is named.
+- ``divergent`` — the chains split; the periodic snapshots bracket the
+  window and the verdict names the FIRST divergent drain (seq + tick),
+  the differing counters, and the affected session request ids.
+- ``geometry_mismatch`` — the rebuilt engine does not match the
+  recorded geometry (named fields); nothing was re-driven.
+
+Model reconstruction: the recording's ``model_spec`` event (written by
+``bench_serve.py --record`` and the dryrun ``blackbox`` leg) names the
+catalog model; ``--model`` overrides it for recordings that lack one.
+
+Usage:
+  python scripts/replay_session.py SESSION.jsonl            # verdict
+  python scripts/replay_session.py SESSION.jsonl --strict   # CI: exit 1
+  python scripts/replay_session.py SESSION.jsonl --validate-only
+
+The full JSON verdict is the LAST stdout line (the repo's
+consumers-parse-the-last-line contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="replay a tdx-session-v1 recording and report the "
+        "digest-chain verdict"
+    )
+    p.add_argument("recording", help="path to the session JSONL")
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform to replay on (default: cpu — a TPU recording "
+        "replayed here judges platform determinism, not the code)",
+    )
+    p.add_argument(
+        "--model",
+        default=None,
+        help="catalog model name override when the recording has no "
+        "model_spec event",
+    )
+    p.add_argument(
+        "--validate-only",
+        action="store_true",
+        help="schema + digest-chain integrity only; no re-execution",
+    )
+    p.add_argument(
+        "--allow-truncated",
+        action="store_true",
+        help="validation: a missing session_end is a note, not an error",
+    )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 unless the verdict is match/truncated_match",
+    )
+    p.add_argument(
+        "--json", dest="json_out", default=None,
+        help="also write the verdict to this path",
+    )
+    return p.parse_args(argv)
+
+
+def _model_factory(events, override):
+    """Build the recorded model: ``model_spec`` names it (bench --record
+    and the dryrun leg write one); --model overrides.  Seeded through
+    the rng counter stream, so the build is bit-identical every time."""
+    spec = next(
+        (e for e in events if e.get("kind") == "model_spec"), None
+    )
+    name = override or (spec or {}).get("name")
+    if name is None:
+        raise SystemExit(
+            "recording has no model_spec event — pass --model <catalog "
+            "name> (e.g. tiny) to name the model it served"
+        )
+    seed = int((spec or {}).get("seed", 0))
+    dtype_name = (spec or {}).get("dtype", "float32")
+
+    def build():
+        import jax.numpy as jnp
+
+        import torchdistx_tpu as tdx
+        from torchdistx_tpu.models import Llama
+
+        tdx.manual_seed(seed)
+        model = tdx.deferred_init(
+            Llama.from_name, name, dtype=getattr(jnp, dtype_name)
+        )
+        tdx.materialize_module(model)
+        return model
+
+    return build
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from torchdistx_tpu.obs.blackbox import (
+        geometry_kwargs,
+        load_session,
+        replay_session,
+        validate_session_jsonl,
+    )
+
+    errors = validate_session_jsonl(
+        args.recording, allow_truncated=args.allow_truncated
+    )
+    for e in errors:
+        print(f"INVALID: {e}")
+    if args.validate_only:
+        out = {
+            "schema": "tdx-session-verdict-v1",
+            "verdict": "valid" if not errors else "invalid",
+            "errors": errors,
+        }
+        print(json.dumps(out))
+        return 1 if errors and args.strict else 0
+    # a torn/truncated recording still replays its complete prefix;
+    # only a corrupt CHAIN is unreplayable evidence
+    fatal = [e for e in errors if "chain" in e or "unparseable" in e]
+    if fatal:
+        print(json.dumps({
+            "schema": "tdx-session-verdict-v1",
+            "verdict": "invalid",
+            "errors": errors,
+        }))
+        return 1
+
+    events, _notes = load_session(args.recording)
+    build_model = _model_factory(events, args.model)
+    is_fleet = any(e.get("kind") == "fleet" for e in events)
+    if is_fleet:
+        # one deterministic model shared by every rebuilt replica (the
+        # fleet posture); each replica rebuilds from ITS geometry event
+        from torchdistx_tpu.serve import ServeEngine
+
+        model = build_model()
+
+        def engine_factory(rec, geom):
+            return ServeEngine(
+                model, record=rec, **geometry_kwargs(geom)
+            )
+
+        verdict = replay_session(events, engine_factory=engine_factory)
+    else:
+        verdict = replay_session(events, model_factory=build_model)
+
+    ok = bool(verdict.get("match"))
+    v = verdict.get("verdict")
+    if ok:
+        print(
+            f"REPLAY {v.upper()}: {verdict.get('drains_replayed')} drains "
+            f"bit-identical (chain {str(verdict.get('chain_replayed'))[:16]}...)"
+        )
+    elif v == "geometry_mismatch":
+        print(
+            "REPLAY GEOMETRY MISMATCH: fields "
+            f"{verdict.get('geometry_fields')} differ from the recording"
+        )
+    else:
+        d = verdict.get("first_divergence") or {}
+        print(
+            f"REPLAY DIVERGENT at drain seq={d.get('seq')} "
+            f"tick={d.get('tick')} source={d.get('source')}: "
+            f"counters={d.get('counters')} request_ids={d.get('rids')}"
+        )
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if ok or not args.strict else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

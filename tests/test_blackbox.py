@@ -1,0 +1,697 @@
+"""Incident time machine (ISSUE 20): the tdx-session-v1 black box.
+
+The pinned invariants, on the 8-device CPU mesh:
+
+- **Schema round-trip**: a recorded session streams to JSONL with
+  per-event flush, loads back identically, and passes
+  ``validate_session_jsonl`` (header first, dense drain seqs, the
+  digest chain recomputable from the drain payloads, snapshots
+  anchored, ``session_end`` consistent).
+- **Bit-exact replay**: ``replay_session`` rebuilds the engine from
+  the recorded geometry, re-drives the exact submit/step stream, and
+  every drain-boundary digest matches — ``verdict == "match"``.
+- **Kill-mid-run**: a truncated recording (no ``session_end``, torn
+  final line) replays its complete prefix bit-identically and the
+  verdict names the truncation point — ``truncated_match``.
+- **Divergence localization**: a single perturbed counter delta, a
+  single perturbed token, and a mis-built geometry each produce a
+  DISTINCT named verdict — the first divergent drain seq + tick +
+  counter names, the affected session request ids, or the differing
+  geometry fields.  ``rechain`` makes the injected recording exactly
+  as internally consistent as a live run that really diverged there.
+- **Zero overhead** (satellite 3): recording changes NO engine counter
+  — ``host_syncs`` included — because every hashed value is already
+  host-side at the drain hook.  ``TDX_SESSION_RECORD=0`` turns every
+  implicit recorder into a no-op (the TDX_COST_CARDS switch pattern).
+- **Autoscale bridge** (satellite 2): the recorded live signal vectors
+  feed ``serve.autoscale.replay_signal`` and the (tick, action)
+  decision stream replays bit-identically against the recorded
+  ``("scale", ...)`` fleet events.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchdistx_tpu as tdx
+from torchdistx_tpu.models import Llama
+from torchdistx_tpu.obs.blackbox import (
+    SESSION_SCHEMA,
+    SessionRecorder,
+    geometry_kwargs,
+    load_session,
+    rechain,
+    recording_enabled,
+    replay_session,
+    resolve_record,
+    session_force_disabled,
+    signals_from_session,
+    validate_session_jsonl,
+)
+from torchdistx_tpu.serve import (
+    AutoscaleController,
+    ScalingPolicy,
+    ServeEngine,
+    ServeFleet,
+    replay_signal,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    tdx.manual_seed(7)
+    return Llama.from_name("tiny", n_kv_heads=2, max_seq_len=64)
+
+
+def _engine(model, rec=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("decode_chunk", 4)
+    return ServeEngine(model, record=rec, **kw)
+
+
+def _work(n=4, seed=0, max_new=4, temperature=0.0):
+    rs = np.random.RandomState(seed)
+    return [
+        {
+            "prompt": rs.randint(0, 256, (int(m),)).astype(np.int32),
+            "max_new_tokens": max_new,
+            "temperature": temperature,
+            "seed": i,
+        }
+        for i, m in enumerate(rs.randint(2, 12, n))
+    ]
+
+
+def _record(model, path, **ekw):
+    """One recorded single-engine session; returns (recorder, results)."""
+    rec = SessionRecorder(path, enabled=True)
+    engine = _engine(model, rec, **ekw)
+    results = engine.run([dict(w) for w in _work()])
+    rec.close()
+    return rec, results
+
+
+def _factory(model, **extra):
+    def build(rep_rec, geom):
+        return ServeEngine(
+            model, record=rep_rec, **{**geometry_kwargs(geom), **extra}
+        )
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip
+
+
+class TestSchema:
+    def test_stream_roundtrip_and_validate(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        rec, results = _record(model, path)
+        assert rec.drains > 0 and results
+        assert validate_session_jsonl(path) == []
+        events, notes = load_session(path)
+        assert notes == []
+        assert events[0]["kind"] == "session_header"
+        assert events[0]["schema"] == SESSION_SCHEMA
+        # the streamed file IS the in-memory record, event for event
+        assert events == json.loads(
+            json.dumps(rec.events)
+        ), "JSONL round-trip changed an event"
+        end = events[-1]
+        assert end["kind"] == "session_end"
+        assert end["chain"] == rec.chain and end["drains"] == rec.drains
+        geom = next(e for e in events if e["kind"] == "geometry")
+        for field in ("num_slots", "max_len", "decode_chunk", "kv_dtype"):
+            assert field in geom
+        submits = [e for e in events if e["kind"] == "submit"]
+        assert [s["rid"] for s in submits] == list(range(len(submits)))
+        assert all(
+            isinstance(t, int) for s in submits for t in s["prompt"]
+        )
+
+    def test_snapshots_ride_along(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        rec = SessionRecorder(path, enabled=True, snapshot_every=2)
+        engine = _engine(model, rec)
+        engine.run([dict(w) for w in _work()])
+        rec.close()
+        assert validate_session_jsonl(path) == []
+        snaps = [e for e in rec.events if e["kind"] == "snapshot"]
+        assert len(snaps) == rec.drains // 2
+        assert all("counters" in s and s["chain"] for s in snaps)
+
+    def test_recorder_truncates_stale_file(self, model, tmp_path):
+        """A recorder opened on an existing path must overwrite, not
+        append — a crashed earlier run's leftover file would otherwise
+        become a two-header recording that replays as an unhelpful
+        empty-fields geometry_mismatch."""
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        first = open(path).read()
+        rec, _ = _record(model, path)
+        assert validate_session_jsonl(path) == []
+        events, _ = load_session(path)
+        assert (
+            sum(1 for e in events if e["kind"] == "session_header") == 1
+        )
+        # and a concatenated file (older-code artifact) is named by the
+        # validator, not silently replayed
+        cat = str(tmp_path / "cat.jsonl")
+        with open(cat, "w") as f:
+            f.write(first + open(path).read())
+        errors = validate_session_jsonl(cat)
+        assert any("session_header events" in e for e in errors)
+
+    def test_validator_names_breaks(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        events, _ = load_session(path)
+        # a flipped delta WITHOUT rechain is a broken chain, not a
+        # plausible recording — the validator must say so
+        for e in events:
+            if e["kind"] == "drain" and e.get("delta"):
+                e["delta"] = dict(e["delta"])
+                k = sorted(e["delta"])[0]
+                e["delta"][k] += 1
+                break
+        errors = validate_session_jsonl(events)
+        assert any("digest chain broken" in e for e in errors)
+        # rechained, the same perturbation is internally consistent
+        assert validate_session_jsonl(rechain(events)) == []
+
+
+# ---------------------------------------------------------------------------
+# bit-exact replay
+
+
+class TestReplay:
+    def test_match(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        rec, _ = _record(model, path)
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match" and v["match"]
+        assert v["drains_replayed"] == v["drains_recorded"] == rec.drains
+        assert v["chain_replayed"] == v["chain_recorded"] == rec.chain
+
+    def test_replay_is_deterministic_under_kill_switch(
+        self, model, tmp_path, monkeypatch
+    ):
+        """The replay harness's own recorder is explicit enabled=True —
+        production recording being switched off must not break it."""
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        monkeypatch.setenv("TDX_SESSION_RECORD", "0")
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match"
+
+    def test_truncated_recording_replays_prefix(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        with open(path) as f:
+            lines = f.read().splitlines()
+        # SIGKILL shape: session_end never written, final event torn
+        torn = [ln for ln in lines if '"session_end"' not in ln]
+        torn[-1] = torn[-1][: len(torn[-1]) // 2]
+        with open(path, "w") as f:
+            f.write("\n".join(torn) + "\n")
+        errors = validate_session_jsonl(path)
+        assert any("truncated" in e for e in errors)
+        assert validate_session_jsonl(path, allow_truncated=True) == []
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "truncated_match" and v["match"]
+        assert v["truncated"]
+        assert any("torn final event" in n for n in v["notes"])
+        assert v["truncation"]["seq"] == v["drains_recorded"]
+        assert v["truncation"]["drains_beyond_recording"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# divergence localization
+
+
+class TestDivergenceLocalization:
+    def _perturb(self, events, mutate):
+        """Copy, mutate ONE drain, rechain to internal consistency."""
+        out = [dict(e) for e in events]
+        target = None
+        for e in out:
+            if e["kind"] != "drain":
+                continue
+            if mutate(e):
+                target = e
+                break
+        assert target is not None, "no drain accepted the perturbation"
+        return rechain(out), target
+
+    def test_counter_perturbation_names_drain_and_counter(
+        self, model, tmp_path
+    ):
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        events, _ = load_session(path)
+
+        def bump(e):
+            if not e.get("delta") or "host_syncs" not in e["delta"]:
+                return False
+            if e["seq"] < 2:
+                return False  # a mid-session drain, not the first
+            e["delta"] = dict(e["delta"], host_syncs=e["delta"]["host_syncs"] + 1)
+            return True
+
+        pert, target = self._perturb(events, bump)
+        v = replay_session(pert, engine_factory=_factory(model))
+        assert v["verdict"] == "divergent" and not v["match"]
+        d = v["first_divergence"]
+        assert d["seq"] == target["seq"] and d["tick"] == target["tick"]
+        assert d["counters"] == ["host_syncs"]
+        assert d["rids"] == []
+        assert d["recorded_delta"]["host_syncs"] == (
+            d["replayed_delta"]["host_syncs"] + 1
+        )
+
+    def test_token_perturbation_names_request(self, model, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        events, _ = load_session(path)
+
+        def flip(e):
+            toks = e.get("tokens") or {}
+            if not toks:
+                return False
+            rid = sorted(toks)[0]
+            vals = list(toks[rid])
+            vals[0] = (vals[0] + 1) % 256
+            e["tokens"] = dict(toks, **{rid: vals})
+            return True
+
+        pert, target = self._perturb(events, flip)
+        rid = int(sorted(target["tokens"])[0])
+        v = replay_session(pert, engine_factory=_factory(model))
+        assert v["verdict"] == "divergent"
+        d = v["first_divergence"]
+        assert d["seq"] == target["seq"]
+        assert d["counters"] == []
+        assert d["rids"] == [rid]
+        assert str(rid) in d["recorded_tokens"]
+        assert str(rid) in d["replayed_tokens"]
+
+    def test_geometry_mismatch_names_fields(self, model, tmp_path):
+        """The engine_factory path: the caller's rebuilt engine claims
+        its TRUE geometry, so a recording that says otherwise is a
+        geometry_mismatch verdict — nothing is re-driven."""
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+
+        def wrong(rep_rec, geom):
+            kw = geometry_kwargs(geom)
+            kw["num_slots"] = kw.get("num_slots", 2) + 1
+            return ServeEngine(model, record=rep_rec, **kw)
+
+        v = replay_session(path, engine_factory=wrong)
+        assert v["verdict"] == "geometry_mismatch" and not v["match"]
+        assert v["geometry_fields"] == ["num_slots"]
+        assert v["drains_replayed"] == 0
+        assert "first_divergence" not in v
+
+    def test_three_failure_modes_are_distinct(self, model, tmp_path):
+        """One recording, three injections, three different verdicts."""
+        path = str(tmp_path / "s.jsonl")
+        _record(model, path)
+        events, _ = load_session(path)
+
+        counter, _ = self._perturb(
+            events,
+            lambda e: bool(e.get("delta"))
+            and e.update(delta=dict(e["delta"], host_syncs=99)) is None,
+        )
+        vc = replay_session(counter, engine_factory=_factory(model))
+        vg = replay_session(
+            path,
+            engine_factory=lambda r, g: ServeEngine(
+                model, record=r, **dict(geometry_kwargs(g), decode_chunk=2)
+            ),
+        )
+        vm = replay_session(path, engine_factory=_factory(model))
+        assert (vm["verdict"], vc["verdict"], vg["verdict"]) == (
+            "match",
+            "divergent",
+            "geometry_mismatch",
+        )
+
+
+# ---------------------------------------------------------------------------
+# zero overhead + kill switch (satellite 3)
+
+
+class TestRecordingOverhead:
+    def test_recording_moves_no_counter(self, model):
+        """The satellite-3 pin behind the serve_cpu_smoke expectations:
+        an engine with recording ON serves the identical workload with
+        IDENTICAL integer counters — host_syncs included — because
+        every hashed value is already host-side at the drain hook."""
+        bare = _engine(model)
+        out_a = bare.run([dict(w) for w in _work()])
+        rec = SessionRecorder(None, enabled=True)
+        taped = _engine(model, rec)
+        out_b = taped.run([dict(w) for w in _work()])
+        rec.close()
+        assert rec.drains > 0
+        assert bare.metrics.counters == taped.metrics.counters
+        for a, b in zip(out_a, out_b):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_kill_switch_spellings(self, monkeypatch):
+        for off in ("0", "false", "FALSE", "", "  0  "):
+            monkeypatch.setenv("TDX_SESSION_RECORD", off)
+            assert not recording_enabled()
+            assert session_force_disabled()
+        for on in ("1", "true", "yes"):
+            monkeypatch.setenv("TDX_SESSION_RECORD", on)
+            assert recording_enabled()
+            assert not session_force_disabled()
+        monkeypatch.delenv("TDX_SESSION_RECORD")
+        assert recording_enabled() and not session_force_disabled()
+
+    def test_kill_switch_makes_recorder_noop(
+        self, model, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TDX_SESSION_RECORD", "0")
+        path = str(tmp_path / "off.jsonl")
+        engine = _engine(model, path)
+        engine.run([dict(w) for w in _work(n=2)])
+        rec = engine.recorder
+        assert rec is not None and not rec.enabled
+        assert rec.events == [] and rec.drains == 0
+        assert not os.path.exists(path)
+        # explicit enabled=True still records (the replay harness path)
+        live = SessionRecorder(None, enabled=True)
+        assert live.enabled and live.events
+
+    def test_resolve_record_surface(self, tmp_path):
+        assert resolve_record(None) is None
+        rec = SessionRecorder(None, enabled=True)
+        assert resolve_record(rec) is rec
+        mem = resolve_record(True)
+        assert isinstance(mem, SessionRecorder) and mem.path is None
+        p = str(tmp_path / "r.jsonl")
+        assert resolve_record(p).path == p
+        with pytest.raises(TypeError):
+            resolve_record(3.14)
+
+
+# ---------------------------------------------------------------------------
+# autoscale bridge (satellite 2)
+
+
+class TestAutoscaleBridge:
+    POLICY = ScalingPolicy(
+        min_replicas=1,
+        max_replicas=2,
+        windows=(2, 6),
+        up_sustain=2,
+        down_sustain=4,
+        up_cooldown=2,
+        down_cooldown=4,
+    )
+
+    def test_decision_stream_replays_bit_identically(
+        self, model, tmp_path
+    ):
+        path = str(tmp_path / "fleet.jsonl")
+        rec = SessionRecorder(path, enabled=True)
+        fleet = ServeFleet([_engine(model)], record=rec)
+        vectors = [{"state": "warn"}] * 3 + [{"state": "ok"}] * 9
+        ctrl = AutoscaleController(
+            fleet,
+            self.POLICY,
+            engine_factory=lambda role="serve": _engine(model),
+            signal_fn=replay_signal(vectors),
+            flight=False,
+        )
+        for w in _work(n=3):
+            fleet.submit(**w)
+        for _ in range(len(vectors)):
+            fleet.step()
+            ctrl.tick()
+        while fleet.step():
+            pass
+        rec.close()
+        assert validate_session_jsonl(path) == []
+
+        events, _ = load_session(path)
+        # the recorded signal vectors ARE the controller's outside world
+        recorded_sigs = signals_from_session(events)
+        assert len(recorded_sigs) == len(vectors)
+        assert [s["state"] for s in recorded_sigs[:3]] == ["warn"] * 3
+        # recorded ctrl_tick decisions == the fleet's ("scale", ...)
+        # events, tick for tick — the bridge records what happened
+        scale_evs = [
+            (d["tick"], d["action"])
+            for n, _ts, d in fleet.events
+            if n == "scale"
+        ]
+        ct_evs = [
+            (e["tick"], e["action"])
+            for e in events
+            if e["kind"] == "ctrl_tick"
+        ]
+        assert ct_evs == scale_evs
+        assert any(
+            a == "scale_up" for _, a in ct_evs
+        ), f"no scale-up recorded: {ct_evs}"
+
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match", v
+        assert v["autoscale"] == {"ticks": len(vectors), "match": True}
+
+    def test_perturbed_signal_diverges_the_decision_stream(
+        self, model, tmp_path
+    ):
+        path = str(tmp_path / "fleet.jsonl")
+        rec = SessionRecorder(path, enabled=True)
+        fleet = ServeFleet([_engine(model)], record=rec)
+        vectors = [{"state": "warn"}] * 3 + [{"state": "ok"}] * 9
+        ctrl = AutoscaleController(
+            fleet,
+            self.POLICY,
+            engine_factory=lambda role="serve": _engine(model),
+            signal_fn=replay_signal(vectors),
+            flight=False,
+        )
+        for w in _work(n=2):
+            fleet.submit(**w)
+        for _ in range(len(vectors)):
+            fleet.step()
+            ctrl.tick()
+        while fleet.step():
+            pass
+        rec.close()
+        events, _ = load_session(path)
+        # flip every recorded warn to ok: the replayed controller never
+        # scales, so the decision stream must diverge and say so
+        out = []
+        for e in events:
+            e = dict(e)
+            if e.get("kind") == "ctrl_tick" and e.get("signal"):
+                e["signal"] = dict(e["signal"], state="ok")
+            out.append(e)
+        v = replay_session(rechain(out), engine_factory=_factory(model))
+        assert v["autoscale"]["match"] is False
+        assert v["verdict"] == "divergent"
+
+
+# ---------------------------------------------------------------------------
+# fleet + variant grid
+
+
+class TestFleetRecording:
+    def test_fleet_replay_match(self, model, tmp_path):
+        path = str(tmp_path / "fleet.jsonl")
+        rec = SessionRecorder(path, enabled=True)
+        fleet = ServeFleet(
+            [_engine(model), _engine(model)], record=rec
+        )
+        for w in _work(n=4):
+            fleet.submit(**w)
+        while fleet.step():
+            pass
+        rec.close()
+        assert validate_session_jsonl(path) == []
+        events, _ = load_session(path)
+        fl_ev = next(e for e in events if e["kind"] == "fleet")
+        assert len(fl_ev["replicas"]) == 2
+        sources = {
+            e["source"] for e in events if e["kind"] == "drain"
+        }
+        assert len(sources) >= 1  # per-replica digest streams
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match", v
+        assert v["chain_replayed"] == v["chain_recorded"]
+
+
+VARIANTS = {
+    "paged": dict(page_size=8, num_pages=32),
+    "speculative": dict(
+        decode_mode="persistent", speculate=2, spec_ngram=2
+    ),
+    "int8": dict(kv_dtype="int8"),
+    "persistent": dict(decode_mode="persistent"),
+}
+
+
+@pytest.mark.slow
+class TestVariantGridSlow:
+    """The exhaustive engine-shape grid (fast siblings above cover the
+    default geometry): every variant records, validates, and replays
+    bit-identically, and a counter perturbation still localizes."""
+
+    @pytest.mark.parametrize("name", sorted(VARIANTS))
+    def test_variant_replay_match(self, model, tmp_path, name):
+        path = str(tmp_path / f"{name}.jsonl")
+        _record(model, path, **VARIANTS[name])
+        assert validate_session_jsonl(path) == []
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match", (name, v)
+
+    @pytest.mark.parametrize("name", ["paged", "int8"])
+    def test_variant_perturbation_localizes(self, model, tmp_path, name):
+        path = str(tmp_path / f"{name}.jsonl")
+        _record(model, path, **VARIANTS[name])
+        events, _ = load_session(path)
+        drains = [
+            e for e in events if e["kind"] == "drain" and e.get("delta")
+        ]
+        target = drains[len(drains) // 2]
+        out = []
+        for e in events:
+            e = dict(e)
+            if e.get("kind") == "drain" and e.get("seq") == target["seq"]:
+                k = sorted(e["delta"])[0]
+                e["delta"] = dict(e["delta"], **{k: e["delta"][k] + 1})
+            out.append(e)
+        v = replay_session(rechain(out), engine_factory=_factory(model))
+        assert v["verdict"] == "divergent"
+        assert v["first_divergence"]["seq"] == target["seq"]
+
+    def test_fleet_speculative_int8_composition(self, model, tmp_path):
+        """The full stack in one recording: a 2-replica fleet of paged
+        int8 speculative persistent engines."""
+        kw = dict(
+            decode_mode="persistent",
+            speculate=2,
+            spec_ngram=2,
+            kv_dtype="int8",
+        )
+        path = str(tmp_path / "composed.jsonl")
+        rec = SessionRecorder(path, enabled=True)
+        fleet = ServeFleet(
+            [_engine(model, **kw), _engine(model, **kw)], record=rec
+        )
+        for w in _work(n=4):
+            fleet.submit(**w)
+        while fleet.step():
+            pass
+        rec.close()
+        assert validate_session_jsonl(path) == []
+        v = replay_session(path, engine_factory=_factory(model))
+        assert v["verdict"] == "match", v
+
+
+# ---------------------------------------------------------------------------
+# trainer analog
+
+
+class TestTrainerRecording:
+    def _trainer(self, mesh8, rec):
+        from torchdistx_tpu import nn
+        from torchdistx_tpu.nn import functional_call
+        from torchdistx_tpu.optimizers import anyprecision_adamw
+        from torchdistx_tpu.parallel import ShardedTrainStep
+        from torchdistx_tpu.trainer import Trainer
+
+        tdx.manual_seed(0)
+        model = tdx.deferred_init(
+            lambda: nn.Sequential(nn.Embedding(64, 32), nn.Linear(32, 64))
+        )
+        tdx.materialize_module(model)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return nn.functional.cross_entropy(
+                functional_call(model, p, (x,)), y
+            )
+
+        step = ShardedTrainStep(
+            loss_fn, anyprecision_adamw(1e-2), mesh8, shard_axis="fsdp"
+        )
+        params = step.shard_params(dict(model.named_parameters()))
+        return Trainer(step, params, record=rec, log_every=100)
+
+    def test_fit_records_batch_identity(self, mesh8):
+        from torchdistx_tpu.data import DataLoader, TokenDataset
+
+        rec = SessionRecorder(None, enabled=True)
+        tr = self._trainer(mesh8, rec)
+        ds = TokenDataset(np.arange(2000) % 64, seq_len=16)
+        dl = DataLoader(ds, batch_size=8, shuffle=True, seed=0, prefetch=0)
+        tr.fit(iter(dl), num_steps=3)
+        head = next(e for e in rec.events if e["kind"] == "trainer")
+        assert head["step_type"] == "ShardedTrainStep"
+        steps = [e for e in rec.events if e["kind"] == "train_step"]
+        assert [e["step"] for e in steps] == [0, 1, 2]
+        assert all(
+            isinstance(e["batch"], str) and len(e["batch"]) == 64
+            for e in steps
+        )
+        assert all(e["rng_counter"] is not None for e in steps)
+        # same data order ⇒ same digests; the digest IS batch identity
+        rec2 = SessionRecorder(None, enabled=True)
+        tr2 = self._trainer(mesh8, rec2)
+        dl2 = DataLoader(ds, batch_size=8, shuffle=True, seed=0, prefetch=0)
+        tr2.fit(iter(dl2), num_steps=3)
+        steps2 = [e for e in rec2.events if e["kind"] == "train_step"]
+        assert [e["batch"] for e in steps] == [e["batch"] for e in steps2]
+
+    def test_batch_digest_is_content_addressed(self):
+        from torchdistx_tpu.trainer import batch_digest
+
+        a = (np.arange(8, dtype=np.int32), np.ones((2, 2)))
+        b = (np.arange(8, dtype=np.int32), np.ones((2, 2)))
+        c = (np.arange(8, dtype=np.int32), np.zeros((2, 2)))
+        assert batch_digest(a) == batch_digest(b)
+        assert batch_digest(a) != batch_digest(c)
+        # dtype is identity too, not just bytes
+        assert batch_digest(np.arange(4, dtype=np.int32)) != batch_digest(
+            np.arange(4, dtype=np.int64)
+        )
+
+
+# ---------------------------------------------------------------------------
+# flight integration
+
+
+class TestFlightIntegration:
+    def test_flight_dump_names_the_session(self, model, tmp_path):
+        from torchdistx_tpu.obs import get_flight_recorder
+
+        flight = get_flight_recorder()
+        before = flight.session_path
+        try:
+            path = str(tmp_path / "s.jsonl")
+            _record(model, path)
+            assert flight.session_path == path
+            os.environ["TDX_FLIGHT_DIR"] = str(tmp_path)
+            try:
+                dump = flight.dump(reason="test")
+            finally:
+                os.environ.pop("TDX_FLIGHT_DIR", None)
+            with open(dump) as f:
+                header = json.loads(f.readline())
+            assert header["session"] == path
+        finally:
+            flight.session_path = before

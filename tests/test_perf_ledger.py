@@ -634,7 +634,12 @@ class TestCLIs:
         # establish, hence their own fingerprint family) + the ISSUE 17
         # int8 --kv-quant-ab rider (kv_dtype=int8 tags its fingerprint,
         # so the quantized family never collides with the default pins)
-        assert len(phase_fps) == 7
+        # + the ISSUE 19 --numerics rider (numerics=True phase pins and
+        # its per-site numerics_site= digest families)
+        num_fps = {fp for fp in phase_fps if "phase=numerics" in fp}
+        assert len(num_fps) == 4
+        assert all("numerics=True" in fp for fp in num_fps)
+        assert len(phase_fps - num_fps) == 7
         kvq_fps = {fp for fp in phase_fps if "phase=kv_quant" in fp}
         assert len(kvq_fps) == 1 and "kv_dtype=int8" in next(iter(kvq_fps))
         assert any(

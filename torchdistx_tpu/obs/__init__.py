@@ -80,8 +80,39 @@ rather than resources (docs/observability.md "Numerics observatory"):
   boundaries; nonfinite provenance names the earliest bad site in
   flight events; exported as ``tdx_numerics_*`` gauges, Perfetto
   counter tracks, and exact ledger counter rows.
+
+PR 20 adds the *incident time machine* — the layer that re-executes
+(docs/observability.md "Incident time machine"):
+
+- :mod:`~torchdistx_tpu.obs.blackbox` — streaming ``tdx-session-v1``
+  session black box: every boundary crossing into a serve session
+  (geometry, submits with token ids + sampling params, fleet ticks,
+  autoscale signal vectors, env stamp) with per-event flush, plus a
+  rolling SHA-256 digest chain folded at every drain boundary over the
+  deterministic integer counters + emitted tokens (zero extra host
+  syncs; periodic full-counter snapshots as bisection waypoints).
+  :func:`replay_session` rebuilds the engine/fleet from the recording,
+  re-drives the exact stream, and on mismatch bisects to the first
+  divergent drain (seq + tick), the differing counters, and the
+  affected request ids.  ``ServeEngine(record=...)`` /
+  ``ServeFleet(record=...)`` / ``Trainer(record=...)`` wire it in;
+  ``TDX_SESSION_RECORD=0`` is the kill switch;
+  ``scripts/replay_session.py`` is the CLI.
 """
 
+from .blackbox import (
+    SESSION_SCHEMA,
+    SessionRecorder,
+    geometry_kwargs,
+    load_session,
+    rechain,
+    recording_enabled,
+    replay_session,
+    resolve_record,
+    session_force_disabled,
+    signals_from_session,
+    validate_session_jsonl,
+)
 from .comm import CommProfile, comm_audit, record_collective
 from .cost import (
     CostBook,
@@ -215,4 +246,15 @@ __all__ = [
     "tap",
     "tap_error",
     "tree_digest",
+    "SESSION_SCHEMA",
+    "SessionRecorder",
+    "geometry_kwargs",
+    "load_session",
+    "rechain",
+    "recording_enabled",
+    "replay_session",
+    "resolve_record",
+    "session_force_disabled",
+    "signals_from_session",
+    "validate_session_jsonl",
 ]

@@ -56,6 +56,11 @@ class FlightRecorder:
         self.dump_dir = dump_dir
         self.dumps_total = 0
         self.last_dump_path: Optional[str] = None
+        # the session black box (obs/blackbox.py) paired with this run:
+        # set when an engine/fleet/trainer attaches a path-backed
+        # SessionRecorder, embedded in every dump header so any
+        # incident artifact names its replayable recording
+        self.session_path: Optional[str] = None
         self._ring: deque = deque(maxlen=self.capacity)
         self._recorded = 0  # lifetime count (ring overwrites drop old)
         self._lock = threading.Lock()
@@ -158,6 +163,8 @@ class FlightRecorder:
             "events": len(ring),
             "dropped": dropped,
         }
+        if self.session_path:
+            header["session"] = self.session_path
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(header) + "\n")

@@ -287,6 +287,13 @@ class AutoscaleController:
         self.engine_factory = engine_factory
         self.signal_fn = signal_fn or LoadSignal(self.policy)
         self.flight = flight
+        if getattr(fleet, "_bb_on", False):
+            # session black box: the policy is part of the recorded
+            # outside world — replay rebuilds this controller from it
+            # (obs/blackbox.py) and re-drives the recorded signal
+            # vectors through replay_signal for a bit-identical
+            # decision stream
+            fleet.recorder.record("autoscale", policy=self.policy.to_json())
         self.counters = {
             "autoscale_decisions": 0,
             "autoscale_scale_ups": 0,
@@ -375,6 +382,19 @@ class AutoscaleController:
             "signal": sig,
         }
         self.fleet.events.append(("scale", time.monotonic(), data))
+        if getattr(self.fleet, "_bb_on", False):
+            # driver event (the live signal vector is the controller's
+            # entire outside world) + decision attribution: replay
+            # compares (tick, action, replica) streams exactly
+            self.fleet.recorder.record(
+                "ctrl_tick",
+                tick=data["tick"],
+                action=action,
+                mode=mode,
+                replica=replica,
+                reason=data["reason"],
+                signal=sig,
+            )
         if self.flight:
             from ..obs.flight import get_flight_recorder
 

@@ -90,6 +90,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.blackbox import resolve_record
 from ..obs.comm import record_collective
 from ..obs.cost import CostBook, force_disabled as _cost_force_disabled
 from ..obs.numerics import (
@@ -416,6 +417,7 @@ class ServeEngine:
         chunked_prefill: Optional[int] = None,
         speculate: int = 0,
         spec_ngram: int = 2,
+        record: Any = None,
     ):
         _check_sampling_args(top_k, top_p)
         cfg = getattr(model, "cfg", None)
@@ -601,6 +603,7 @@ class ServeEngine:
         # "bfloat16"/"float16"/"float32" are plain cast caches (A/B
         # baselines); None keeps the model's own cache dtype
         self.kv_dtype = canonicalize_kv_dtype(kv_dtype)
+        self._prefix_cache_flag = bool(prefix_cache)
         self.page_size = None if page_size is None else int(page_size)
         self.paged = self.page_size is not None
         if self.paged:
@@ -721,6 +724,19 @@ class ServeEngine:
             self.watchdog = DispatchWatchdog(
                 stall_timeout_s, book=self.cost_book
             )
+        # session black box (ISSUE 20): the recorder streams geometry +
+        # driver events and folds a digest chain at every drain boundary
+        # (obs/blackbox.py).  Under TDX_SESSION_RECORD=0 resolve_record
+        # yields a disabled recorder and every hook below is dead.
+        self.recorder = None
+        self._bb_on = False
+        self._bb_driver = True
+        self._bb_source = "engine"
+        self._bb_in_drain = False
+        self._bb_finished_pending: list = []
+        rec = resolve_record(record)
+        if rec is not None:
+            self.attach_recorder(rec)
 
     # -- public API ------------------------------------------------------
 
@@ -801,6 +817,13 @@ class ServeEngine:
         )
         self.scheduler.submit(req)
         self.metrics.count("requests_submitted")
+        if self._bb_on:
+            if self._bb_driver:
+                self.recorder.record_submit(self._bb_source, req)
+            else:
+                # fleet-driven replica: the fleet recorded the submit;
+                # register identity so drain tokens key on the session id
+                self.recorder.register_request(req.trace_id)
         return RequestHandle(req)
 
     def step(self) -> int:
@@ -811,6 +834,9 @@ class ServeEngine:
         running-request deadlines are checked once per chunk (a deadline
         can overshoot by at most one chunk's wall time).  Returns the
         number of unfinished requests (queued + running)."""
+        if self._bb_on and self._bb_driver and not self._bb_in_drain:
+            self.recorder.tick += 1
+            self.recorder.record("step", tick=self.recorder.tick)
         now = time.monotonic()
         for req in self.scheduler.expire_queued(now):
             self._count_finish(req)
@@ -849,6 +875,9 @@ class ServeEngine:
                 "persistent loop defers first-token fetches to a decode "
                 "drain a prefill-role engine never runs"
             )
+        if self._bb_on and self._bb_driver and not self._bb_in_drain:
+            self.recorder.tick += 1
+            self.recorder.record("step_prefill", tick=self.recorder.tick)
         now = time.monotonic()
         for req in self.scheduler.expire_queued(now):
             self._count_finish(req)
@@ -886,6 +915,100 @@ class ServeEngine:
             pass
         return [h.result() for h in handles]
 
+    # -- session black box (obs/blackbox.py) -----------------------------
+
+    def attach_recorder(
+        self,
+        recorder,
+        *,
+        source: str = "engine",
+        driver: bool = True,
+        geometry_extra: Optional[dict] = None,
+    ) -> None:
+        """Wire a :class:`~torchdistx_tpu.obs.blackbox.SessionRecorder`
+        into this engine.  ``driver=True`` (standalone engine): submits
+        and steps are recorded as driver events.  ``driver=False``
+        (fleet replica): the fleet owns the driver log and this engine
+        contributes only its geometry and its drain digest folds, under
+        ``source`` (the replica name)."""
+        self.recorder = recorder
+        self._bb_source = str(source)
+        self._bb_driver = bool(driver)
+        self._bb_on = bool(getattr(recorder, "enabled", False))
+        self._bb_finished_pending = []
+        if not self._bb_on:
+            return
+        recorder.record(
+            "geometry",
+            source=self._bb_source,
+            **self.session_geometry(),
+            **(geometry_extra or {}),
+        )
+        if recorder.path:
+            # every flight/crash/watchdog dump names the black box it
+            # pairs with — an incident artifact that cannot be replayed
+            # is a post-mortem, not a reproduction
+            try:
+                from ..obs.flight import get_flight_recorder
+
+                get_flight_recorder().session_path = recorder.path
+            except Exception:
+                pass
+
+    def session_geometry(self) -> dict:
+        """Everything :func:`~torchdistx_tpu.obs.blackbox.replay_session`
+        needs to rebuild this engine, plus attribution (plan
+        fingerprint, resolved storage dtype, model class)."""
+        return {
+            "model": type(self.model).__name__,
+            "num_slots": self.num_slots,
+            "max_len": self.max_len,
+            "eos_token": self.eos_token,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "prefill_buckets": list(self.prefill_buckets),
+            "decode_chunk": self.decode_chunk,
+            "decode_mode": self.decode_mode,
+            "ring_capacity": self.ring_capacity,
+            "page_size": self.page_size,
+            "num_pages": self.num_pages,
+            "kv_dtype": self.kv_dtype,
+            "kv_dtype_name": self.kv_dtype_name,
+            "chunked_prefill": self.chunked_prefill,
+            "speculate": self.speculate,
+            "spec_ngram": self.spec_ngram,
+            "prefix_cache": self._prefix_cache_flag,
+            "tp": self.tp,
+            "plan": (
+                None
+                if self.plan is None
+                else getattr(self.plan, "name", type(self.plan).__name__)
+            ),
+        }
+
+    def _record_drain(self) -> None:
+        """Fold one drain boundary into the session digest chain: the
+        integer-counter delta plus every token this drain's walk
+        appended, keyed by session request id.  Sits at the END of each
+        walk that counted ``host_syncs``, reading only state the sync
+        already materialized — recording adds ZERO host syncs (pinned
+        in tests/test_blackbox.py and the serve expectations)."""
+        if not self._bb_on:
+            return
+        rec = self.recorder
+        toks: dict = {}
+        pend, self._bb_finished_pending = self._bb_finished_pending, []
+        for req in list(self.scheduler.running) + pend:
+            sid = rec.session_rid(req.trace_id)
+            if sid is None:
+                continue  # submitted before the recorder attached
+            done = getattr(req, "_bb_emitted", 0)
+            tail = req.generated[done:]
+            if tail:
+                toks[sid] = [int(t) for t in tail]
+                req._bb_emitted = len(req.generated)
+        rec.drain(self._bb_source, self.metrics.counters, toks)
+
     # -- elastic drain / live migration ----------------------------------
 
     def drain(self, *, complete: bool = False) -> int:
@@ -902,6 +1025,18 @@ class ServeEngine:
         flushed (one host sync) so the suspended state is complete.
         Returns the number of unfinished requests (queued + suspended).
         """
+        if self._bb_on and self._bb_driver and not self._bb_in_drain:
+            # intent log, recorded BEFORE execution: a kill mid-drain
+            # leaves the event, and replay re-enters the same drain.
+            # Inner step()s are the drain's own, not driver events.
+            self.recorder.record("engine_drain", complete=bool(complete))
+        self._bb_in_drain = True
+        try:
+            return self._drain_impl(complete=complete)
+        finally:
+            self._bb_in_drain = False
+
+    def _drain_impl(self, *, complete: bool) -> int:
         self._draining = True
         now = time.monotonic()
         # the queued head learns WHY it stopped moving right away — not
@@ -922,6 +1057,7 @@ class ServeEngine:
             self._harvest_numerics()
             self._record_first(req, tok, now)
             self._check_finished(req, tok, now)
+        self._record_drain()  # the flush above was a drain boundary
         if complete:
             while self.scheduler.running:
                 self.step()
@@ -2038,6 +2174,7 @@ class ServeEngine:
         self._harvest_numerics()
         self._record_first(req, tok, now)
         self._check_finished(req, tok, now)
+        self._record_drain()
 
     def _record_first(self, req: Request, tok: int, now: float) -> None:
         """First-token bookkeeping shared by the chunked path (at
@@ -2398,6 +2535,7 @@ class ServeEngine:
         self.metrics.count("tokens_decoded", emitted)
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+        self._record_drain()
 
     def _persistent_step(self, skip: Optional[Request] = None) -> None:
         """One persistent-loop dispatch: the while_loop runs on-device
@@ -2525,6 +2663,7 @@ class ServeEngine:
         self.metrics.count("tokens_decoded", emitted)
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+        self._record_drain()
 
     def _consume_spec_block(
         self, req: Request, ys_row, c: int, now: float
@@ -2642,6 +2781,7 @@ class ServeEngine:
         self.metrics.count("tokens_decoded", emitted)
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+        self._record_drain()
 
     def _spec_persistent_step(self, skip: Optional[Request] = None) -> None:
         """The speculative sibling of ``_persistent_step``: one
@@ -2752,6 +2892,7 @@ class ServeEngine:
         self.metrics.count("tokens_decoded", emitted)
         if emitted:
             self.metrics.decode_token_s.record(timing["seconds"] / emitted)
+        self._record_drain()
 
     def _check_finished(self, req: Request, tok: int, now: float) -> bool:
         if self.eos_token is not None and tok == self.eos_token:
@@ -2803,3 +2944,7 @@ class ServeEngine:
         if result.tpot_s is not None:
             self.metrics.tpot_s.record(result.tpot_s)
         self._finished.append(req)
+        if self._bb_on:
+            # retired from scheduler.running before the walk's drain
+            # fold — park it so the fold still sees its final tokens
+            self._bb_finished_pending.append(req)

@@ -51,6 +51,7 @@ import itertools
 import time
 from typing import Any, Iterable, List, Optional, Sequence, Union
 
+from ..obs.blackbox import resolve_record as _resolve_record
 from .engine import ServeEngine
 from .scheduler import Request, RequestHandle, RequestResult
 
@@ -254,6 +255,7 @@ class ServeFleet:
         policy: Union[str, Any] = "affinity",
         disaggregate: bool = False,
         roles: Optional[Sequence[str]] = None,
+        record: Any = None,
     ):
         engines = list(engines)
         if not engines:
@@ -325,6 +327,37 @@ class ServeFleet:
         # still show requests that FINISHED on a replica later scaled
         # away — dump_trace() merges these like any live replica's
         self._retired_finished: List[tuple] = []
+        # session black box (obs/blackbox.py): the FLEET is the driver —
+        # it records submits and ticks; replicas contribute geometry and
+        # drain digest folds under their replica name
+        self.recorder = None
+        self._bb_on = False
+        rec = _resolve_record(record)
+        if rec is not None:
+            self.attach_recorder(rec)
+
+    def attach_recorder(self, recorder) -> None:
+        """Wire a :class:`~torchdistx_tpu.obs.blackbox.SessionRecorder`
+        across the fleet: one fleet-composition event, one geometry
+        event per replica, and every replica folding its drains under
+        its ``r<rid>`` source into the single session chain (replicas
+        step serially, so the fold order is deterministic)."""
+        self.recorder = recorder
+        self._bb_on = bool(getattr(recorder, "enabled", False))
+        recorder.record(
+            "fleet",
+            replicas=[r.rid for r in self._replicas],
+            roles=[r.role for r in self._replicas],
+            policy=getattr(self.policy, "name", "custom"),
+            disaggregate=self.disaggregate,
+        )
+        for rep in self._replicas:
+            rep.engine.attach_recorder(
+                recorder,
+                source=f"r{rep.rid}",
+                driver=False,
+                geometry_extra={"role": rep.role},
+            )
 
     # -- rotation ---------------------------------------------------------
 
@@ -470,6 +503,11 @@ class ServeFleet:
               "replica": rep.rid, "policy": policy, "tick": self.tick,
               "candidates": scored})
         )
+        if self._bb_on:
+            # fleet-level driver event: replay re-submits HERE and
+            # re-routes — the routed replica is recorded as attribution,
+            # never replayed as a decision
+            self.recorder.record_submit("fleet", req, routed=rep.rid)
         return handle
 
     # -- stepping ---------------------------------------------------------
@@ -485,6 +523,9 @@ class ServeFleet:
         take their decode ``step()``.  Returns total unfinished
         requests across the fleet."""
         self.tick += 1
+        if self._bb_on:
+            self.recorder.tick = self.tick
+            self.recorder.record("tick", tick=self.tick)
         for rep in self._replicas:
             rep.snapshot_rejections()  # roll the tie-break window
         unfinished = 0
@@ -650,6 +691,7 @@ class ServeFleet:
             for req in rep.engine.finished_requests()
         )
         self._replicas.remove(rep)
+        rep.engine._bb_on = False  # out of rotation: no more chain folds
         out = {**summary, "replica": rep.rid, "to": to, "tick": self.tick}
         self.events.append(("remove", time.monotonic(), out))
         return out
@@ -810,6 +852,18 @@ class ServeFleet:
                 raise
         warm_info = self._warm_engine(engine) if warm else None
         rep.snapshot_rejections()  # warm-up gatings never bias routing
+        if self.recorder is not None:
+            # attach AFTER warm-up: warm traffic ends in a metrics reset,
+            # which would fold negative deltas into the chain.  The
+            # ``added`` flag keeps replay's initial build to the
+            # constructor replicas (scale-ups rebuild live via the
+            # replayed controller).
+            engine.attach_recorder(
+                self.recorder,
+                source=f"r{rep.rid}",
+                driver=False,
+                geometry_extra={"role": role, "added": True},
+            )
         self.events.append(
             ("add", time.monotonic(),
              {"replica": rep.rid, "role": role, "tick": self.tick,

@@ -9,6 +9,7 @@ and periodic checkpoint/resume.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -16,12 +17,42 @@ from typing import Any, Callable, Iterable, Optional
 
 import jax
 
+from .obs.blackbox import resolve_record
 from .obs.comm import CommProfile, comm_audit
 from .obs.flight import get_flight_recorder
 from .obs.trace import get_tracer
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "batch_digest"]
+
+
+def batch_digest(batch: Any) -> str:
+    """Identity digest of one training batch, host-side only: numpy
+    leaves hash by bytes (shape/dtype included), already-on-device
+    leaves by shape/dtype/type — NEVER fetched, so digesting a batch
+    costs zero device syncs.  Two fits fed bit-identical host batches
+    produce identical digests; a shuffled/corrupted pipeline names the
+    first differing step."""
+    h = hashlib.sha256()
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(batch):
+        if isinstance(leaf, np.ndarray):
+            h.update(str((leaf.shape, str(leaf.dtype))).encode())
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        elif isinstance(leaf, (bool, int, float, str, bytes)):
+            h.update(repr(leaf).encode())
+        else:
+            h.update(
+                str(
+                    (
+                        type(leaf).__name__,
+                        getattr(leaf, "shape", None),
+                        str(getattr(leaf, "dtype", "")),
+                    )
+                ).encode()
+            )
+    return h.hexdigest()
 
 
 class Trainer:
@@ -56,6 +87,7 @@ class Trainer:
         peak_flops: Optional[float] = None,
         cost_card: bool = True,
         stall_timeout_s: Optional[float] = None,
+        record: Any = None,
     ) -> None:
         self.step = step
         self.params = params
@@ -85,6 +117,24 @@ class Trainer:
         # defaults to the process-wide recorder (TDX_FLIGHT_DIR sink)
         self.flight = flight if flight is not None else get_flight_recorder()
         self.last_flight_dump: Optional[str] = None
+        # session black box (obs.blackbox): the train-side step-window
+        # analog of the serve recorder.  Every step records its batch
+        # identity digest + the rng counter — with the per-step rng/comm
+        # digests already on the flight ring, a failed window is fully
+        # re-drivable.  TDX_SESSION_RECORD=0 makes this a no-op.
+        self.recorder = resolve_record(record)
+        self._bb_on = bool(getattr(self.recorder, "enabled", False))
+        if self._bb_on:
+            self.recorder.record(
+                "trainer",
+                step_type=type(step).__name__,
+                tokens_per_batch=tokens_per_batch,
+                start_step=self.global_step,
+                rng_counter=self._rng_counter(),
+            )
+            if self.recorder.path:
+                # crash/flight dumps name the black box they pair with
+                self.flight.session_path = self.recorder.path
         # collective-traffic audit: the FIRST call of the step program
         # traces under this profile (obs.comm — trace-time accounting),
         # so after one step it holds the per-step analytic comm plan
@@ -519,6 +569,17 @@ class Trainer:
                 batch = next(it)
             except StopIteration:
                 break
+            if self._bb_on:
+                # batch identity + rng counter per step: the recording
+                # half of bit-exact window replay (flight's per-step
+                # rng/comm digests are the verification half)
+                self._last_batch_digest = batch_digest(batch)
+                self.recorder.record(
+                    "train_step",
+                    step=self.global_step,
+                    rng_counter=self._rng_counter(),
+                    batch=self._last_batch_digest,
+                )
             # a host tracer span per step (obs.trace — no-op unless
             # tracing is enabled); the dispatch is async, so the span
             # measures host-side submit time, not device step time —
@@ -665,6 +726,7 @@ class Trainer:
                     rng_counter=self._rng_counter(),
                     comm=self.comm_profile.digest(),
                     last_checkpoint=self._last_checkpoint,
+                    batch=getattr(self, "_last_batch_digest", None),
                 )
                 self.log_fn(metrics)
                 t_window = time.time()
